@@ -1,0 +1,26 @@
+"""Hyperion-TPU: a TPU-native ML-systems framework (JAX / XLA / pjit / Pallas).
+
+Capability-equivalent rebuild of the Hyperion MI250X reference project
+(see SURVEY.md at the repo root): hardware microbenchmarks, verified data
+pipelines, baseline model benchmarks, mixed precision + rematerialization,
+data-parallel and fully-sharded training over a TPU device mesh, LoRA
+fine-tuning of Llama-2, compiler/kernel benchmarking with Pallas custom
+kernels, collective sanity checks, CSV metrics, and scaling reports —
+designed TPU-first, not ported.
+
+Layering (mirrors SURVEY.md §1, re-expressed for TPU):
+
+  runtime/    mesh + jax.distributed bootstrap + comm_check   (ref L1)
+  precision/  bf16 policies + rematerialization               (ref L2)
+  data/       tokenized-text + CIFAR pipelines, host sharding (ref L3)
+  models/     TransformerLM, ResNet, ViT, Llama-2, LoRA       (ref L3)
+  parallel/   dp / fsdp / tp partition rules, ring attention  (ref L4)
+  train/      jitted train steps + epoch drivers + trainers   (ref L5)
+  checkpoint/ orbax-backed sharded + gathered save/restore    (ref §5.4)
+  metrics/    CSV logger + scaling report                     (ref L6)
+  bench/      hw_explore, baseline, compile_bench             (ref L6)
+  kernels/    Pallas fused attention / layernorm              (ref L0 analogue)
+  cli/        launcher with the reference CLI surface         (ref L7)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,136 @@
+"""Config system — one real, code-driving configuration surface.
+
+The reference has three uncoordinated mechanisms (SURVEY §5.6): argparse
+flags, env vars, and `Phase 1/default_config.json` — a full schema that
+*no code ever loads* (C23). This module keeps the reference's JSON schema
+shape (hardware / optimization / benchmarking / distributed blocks) but
+wires it into every trainer and benchmark, and adds the train-loop
+hyperparameters the reference hardcoded in function bodies
+(`distributed_utils.py:152,161,226,231,334,450,470,503`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from pathlib import Path
+from typing import Any
+
+from hyperion_tpu.runtime.mesh import MeshSpec
+
+
+def _from_dict(cls, d: dict):
+    hints = typing.get_type_hints(cls)
+    names = {f.name for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for k, v in d.items():
+        if k not in names:
+            continue  # forward/back compat: ignore unknown keys
+        t = hints.get(k)
+        if dataclasses.is_dataclass(t) and isinstance(v, dict):
+            v = _from_dict(t, v)
+        elif t is tuple and isinstance(v, list):
+            v = tuple(v)  # JSON arrays come back as lists; keep tuple fields tuples
+        kwargs[k] = v
+    return cls(**kwargs)
+
+
+@dataclasses.dataclass
+class HardwareConfig:
+    platform: str = "tpu"
+    chips_expected: int = 0  # 0 = whatever jax.devices() reports
+    hbm_gb_per_chip: float = 16.0  # v5e
+
+
+@dataclasses.dataclass
+class OptimizationConfig:
+    precision: str = "bf16"          # fp32 | bf16 | bf16_full (precision.policy)
+    remat: str = "none"              # none | full | dots | dots_no_batch
+    grad_accum_steps: int = 1
+    grad_clip_norm: float = 0.0      # 0 disables (FSDP loops use 1.0)
+    compile_tier: str = "jit"        # jit | jit+pallas (compile_bench variants)
+    donate_state: bool = True        # buffer donation into the train step
+
+
+@dataclasses.dataclass
+class DistributedConfig:
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    seq: int = 1
+    coordinator_address: str | None = None
+    num_processes: int | None = None
+    process_id: int | None = None
+
+    def mesh_spec(self) -> MeshSpec:
+        return MeshSpec(data=self.data, fsdp=self.fsdp, model=self.model, seq=self.seq)
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    # reference hardcoded values, per trainer (distributed_utils.py):
+    #   LM DDP: bs 32, lr 2e-4 (:152,161)  CIFAR: bs 64, lr 1e-3 (:226,231)
+    #   LM FSDP: lr 1e-4 (:334)            Llama: bs 1, lr 1e-5 wd 0.01 (:450,503)
+    model: str = "transformer_lm"
+    epochs: int = 3
+    batch_size: int = 32             # per-step GLOBAL batch
+    learning_rate: float = 2e-4
+    weight_decay: float = 0.0
+    seq_len: int = 128               # reference tokenization window
+    seed: int = 0
+    base_dir: str = "data"
+    log_every: int = 50
+    lora: bool = False
+    lora_rank: int = 16              # reference LoraConfig r=16 α=32 (:470)
+    lora_alpha: float = 32.0
+    lora_dropout: float = 0.05
+
+
+@dataclasses.dataclass
+class BenchmarkingConfig:
+    batch_sizes: tuple = (1, 2, 4, 8, 16, 32, 64, 128)
+    models: tuple = ("resnet50", "vit_b16", "custom_transformer")
+    precisions: tuple = ("fp32", "bf16")
+    iterations: int = 50
+    warmup_iterations: int = 10
+
+
+@dataclasses.dataclass
+class Config:
+    hardware: HardwareConfig = dataclasses.field(default_factory=HardwareConfig)
+    optimization: OptimizationConfig = dataclasses.field(default_factory=OptimizationConfig)
+    distributed: DistributedConfig = dataclasses.field(default_factory=DistributedConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    benchmarking: BenchmarkingConfig = dataclasses.field(default_factory=BenchmarkingConfig)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(json.dumps(self.to_dict(), indent=2, default=list))
+
+    @classmethod
+    def load(cls, path: str | Path) -> "Config":
+        return cls.from_dict(json.loads(Path(path).read_text()))
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Config":
+        return _from_dict(cls, d)
+
+    def override(self, **kv) -> "Config":
+        """dotted-path overrides: cfg.override(**{"train.epochs": 5})."""
+        cfg = Config.from_dict(self.to_dict())
+        for key, val in kv.items():
+            obj = cfg
+            *parents, leaf = key.split(".")
+            for p in parents:
+                obj = getattr(obj, p)
+            if not hasattr(obj, leaf):
+                raise AttributeError(f"no config field {key!r}")
+            setattr(obj, leaf, val)
+        return cfg
+
+
+def default_config() -> Config:
+    return Config()

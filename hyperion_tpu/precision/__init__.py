@@ -1,0 +1,2 @@
+from hyperion_tpu.precision.policy import Policy, get_policy  # noqa: F401
+from hyperion_tpu.precision.remat import apply_remat, REMAT_POLICIES  # noqa: F401

@@ -1,0 +1,67 @@
+"""Mixed-precision policies — the AMP-autocast/GradScaler analogue.
+
+Reference: DDP loops run fp16 autocast + `GradScaler`
+(`distributed_utils.py:163,175-180`) and FSDP uses
+`MixedPrecision(param=bf16, reduce=bf16, buffer=bf16)` (`:320-324`).
+
+TPU-native equivalence (SURVEY §7.3): bf16 has fp32's exponent range, so
+the loss-scaling machinery fp16 needs (GradScaler) is structurally
+unnecessary — the policy below is the whole story. Params are kept in
+fp32 (or bf16 under the `"bf16_full"` policy, matching FSDP's
+param-dtype bf16), compute is cast per-step, and gradient reductions
+happen in `reduce_dtype` the way FSDP's `reduce_dtype=bf16` did.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_float(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    name: str
+    param_dtype: jnp.dtype
+    compute_dtype: jnp.dtype
+    reduce_dtype: jnp.dtype
+
+    def cast_to_compute(self, tree):
+        """Cast floating leaves to the compute dtype (the autocast step)."""
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype) if _is_float(x) else x, tree
+        )
+
+    def cast_to_param(self, tree):
+        return jax.tree.map(
+            lambda x: x.astype(self.param_dtype) if _is_float(x) else x, tree
+        )
+
+    def cast_to_reduce(self, tree):
+        return jax.tree.map(
+            lambda x: x.astype(self.reduce_dtype) if _is_float(x) else x, tree
+        )
+
+
+POLICIES = {
+    # full precision — the reference's non-AMP paths
+    "fp32": Policy("fp32", jnp.float32, jnp.float32, jnp.float32),
+    # AMP analogue: fp32 master params, bf16 compute (no scaler needed)
+    "bf16": Policy("bf16", jnp.float32, jnp.bfloat16, jnp.float32),
+    # FSDP MixedPrecision(bf16/bf16/bf16) analogue: bf16 everywhere
+    "bf16_full": Policy("bf16_full", jnp.bfloat16, jnp.bfloat16, jnp.bfloat16),
+}
+
+
+def get_policy(name: str | Policy) -> Policy:
+    if isinstance(name, Policy):
+        return name
+    try:
+        return POLICIES[name]
+    except KeyError:
+        raise ValueError(f"unknown precision policy {name!r}; have {sorted(POLICIES)}")

@@ -1,0 +1,40 @@
+"""Activation rematerialization — the activation-checkpointing analogue.
+
+Reference: `memory_optimization.ipynb cell 3:16-18` wraps transformer
+encoder layers in `checkpoint_sequential`, and cell 4 monkey-patches
+ResNet stages with `torch.utils.checkpoint`.  On TPU the idiomatic form
+is `jax.checkpoint` (remat) with an XLA offloading/recompute policy:
+instead of choosing *which modules* to wrap, you choose *which
+intermediates* are worth keeping (matmul outputs are the expensive ones
+to recompute; elementwise ops are nearly free on the VPU).
+"""
+
+from __future__ import annotations
+
+import jax
+
+_ckpt_policies = jax.checkpoint_policies
+
+REMAT_POLICIES = {
+    # no remat: keep every residual (reference default path)
+    "none": None,
+    # recompute everything (reference checkpoint_sequential over all layers)
+    "full": _ckpt_policies.nothing_saveable,
+    # keep matmul/conv outputs, recompute elementwise — usually the best
+    # FLOPs/HBM trade on TPU and the recommended default
+    "dots": _ckpt_policies.checkpoint_dots,
+    "dots_no_batch": _ckpt_policies.checkpoint_dots_with_no_batch_dims,
+}
+
+
+def apply_remat(fn, policy: str = "none", prevent_cse: bool = True):
+    """Wrap ``fn`` (typically a layer-apply or the whole forward) in
+    jax.checkpoint under the named policy. ``"none"`` returns ``fn``
+    untouched so call sites don't need to branch."""
+    if policy == "none":
+        return fn
+    try:
+        p = REMAT_POLICIES[policy]
+    except KeyError:
+        raise ValueError(f"unknown remat policy {policy!r}; have {sorted(REMAT_POLICIES)}")
+    return jax.checkpoint(fn, policy=p, prevent_cse=prevent_cse)

@@ -1,0 +1,15 @@
+from hyperion_tpu.runtime.mesh import (  # noqa: F401
+    AxisName,
+    MeshSpec,
+    make_mesh,
+    batch_sharding,
+    replicated_sharding,
+)
+from hyperion_tpu.runtime.dist import (  # noqa: F401
+    setup,
+    cleanup,
+    is_primary,
+    process_index,
+    process_count,
+    barrier,
+)

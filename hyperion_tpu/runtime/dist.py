@@ -1,0 +1,116 @@
+"""Multi-host runtime bootstrap — the `setup()`/`cleanup()` equivalent.
+
+Reference: `02_development/distributed_utils.py:96-125` does
+`dist.init_process_group("nccl", init_method="env://", timeout=5min)` per
+GPU process plus `torch.cuda.set_device(rank % ndev)`.  The TPU-native
+shape is one process per *host*: `jax.distributed.initialize` performs
+the coordinator rendezvous (the env:// analogue), after which every
+process sees the global device set and collectives ride ICI/DCN.
+
+Single-host runs (the common dev/bench case, and everything the
+reference's `torchrun --standalone` did) need no rendezvous at all —
+`setup()` is a no-op there, by design rather than accident.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import os
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+# torchrun-style env compatibility: the reference reads RANK/WORLD_SIZE
+# (run_distributed.py:73-79); JAX's native names are also honored.
+_ENV_PROCESS_ID = ("JAX_PROCESS_ID", "PROCESS_ID", "RANK")
+_ENV_NUM_PROCESSES = ("JAX_NUM_PROCESSES", "NUM_PROCESSES", "WORLD_SIZE")
+_ENV_COORDINATOR = ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS", "MASTER_ADDR")
+
+DEFAULT_COORD_PORT = 29500  # reference default MASTER_PORT (distributed_utils.py:103-110)
+DEFAULT_TIMEOUT_S = 300  # reference PG init timeout (distributed_utils.py:111)
+
+
+def _env_first(names) -> str | None:
+    for n in names:
+        v = os.environ.get(n)
+        if v:
+            return v
+    return None
+
+
+def setup(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    init_timeout_s: int = DEFAULT_TIMEOUT_S,
+) -> None:
+    """Initialize the multi-host runtime if (and only if) this run spans
+    more than one process. Safe to call unconditionally, like the
+    reference's `setup(rank, world)`."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    num_processes = num_processes or int(_env_first(_ENV_NUM_PROCESSES) or 1)
+    if num_processes <= 1:
+        return  # single-host: mesh over local devices, no rendezvous
+    process_id = (
+        process_id
+        if process_id is not None
+        else int(_env_first(_ENV_PROCESS_ID) or 0)
+    )
+    coordinator_address = coordinator_address or _env_first(_ENV_COORDINATOR)
+    if coordinator_address and ":" not in coordinator_address:
+        coordinator_address = f"{coordinator_address}:{DEFAULT_COORD_PORT}"
+    log.info(
+        "jax.distributed.initialize coord=%s procs=%d id=%d",
+        coordinator_address, num_processes, process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        initialization_timeout=init_timeout_s,
+    )
+    _INITIALIZED = True
+
+
+def cleanup() -> None:
+    """Tear down the runtime (reference `cleanup()`: barrier + destroy PG,
+    distributed_utils.py:122-125). Barrier first so no process exits while
+    a peer still has collectives in flight."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        barrier("cleanup")
+        jax.distributed.shutdown()
+        _INITIALIZED = False
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_primary() -> bool:
+    """True on the process that owns logging/checkpoint duties — the
+    'rank 0' of the reference's rank-0-only CSV/checkpoint pattern."""
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cross-process sync point (reference: dist.barrier(),
+    distributed_utils.py:369,405). On a single process this is a
+    device-flush, which preserves the 'everything before me finished'
+    meaning for timing code."""
+    if jax.process_count() == 1:
+        jax.effects_barrier()
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

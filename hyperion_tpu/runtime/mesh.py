@@ -1,0 +1,114 @@
+"""Device-mesh construction for data / fsdp / model / seq parallelism.
+
+TPU-native analogue of the reference's process-group runtime
+(`02_development/distributed_utils.py:96-125` — `setup`/`_local_gpu`):
+instead of one process per GPU with NCCL rank mapping, JAX runs one
+process per host and sees every local chip; parallelism is expressed as a
+`jax.sharding.Mesh` whose axes ride the ICI fabric (and DCN across
+slices).  Collectives are inserted by XLA from sharding annotations, the
+role RCCL plays in the reference.
+
+Axes:
+  data   pure data parallelism  (reference: DDP, distributed_utils.py:159)
+  fsdp   parameter/grad/opt-state sharding (reference: FSDP FULL_SHARD,
+         distributed_utils.py:328-332); also shards the batch
+  model  tensor parallelism (absent in the reference — SURVEY §2.2 — but
+         the axis is kept available by design)
+  seq    sequence/context parallelism for ring attention (long-context
+         headroom; absent in the reference, SURVEY §5.7)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class AxisName:
+    DATA = "data"
+    FSDP = "fsdp"
+    MODEL = "model"
+    SEQ = "seq"
+
+    ALL = (DATA, FSDP, MODEL, SEQ)
+    # Batch is sharded over every data-like axis: the fsdp axis also
+    # consumes batch (FSDP is data-parallel in its activation flow).
+    BATCH = (DATA, FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. ``-1`` on exactly one axis means "infer from
+    the device count"; every other axis must divide it."""
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    seq: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = dataclasses.asdict(self)
+        infer = [k for k, v in sizes.items() if v == -1]
+        if len(infer) > 1:
+            raise ValueError(f"at most one axis may be -1, got {infer}")
+        bad = {k: v for k, v in sizes.items() if v != -1 and v < 1}
+        if bad:
+            raise ValueError(f"axis sizes must be >= 1 (or -1 to infer): {bad}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if infer:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}"
+                )
+            sizes[infer[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh {sizes} wants {fixed} devices, have {n_devices}")
+        return MeshSpec(**sizes)
+
+    @property
+    def shape(self) -> tuple[int, int, int, int]:
+        return (self.data, self.fsdp, self.model, self.seq)
+
+
+def make_mesh(
+    spec: MeshSpec | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the global mesh. Defaults to all-data-parallel over every
+    addressable device — the analogue of the reference's torchrun
+    world with one DDP rank per GPU."""
+    devices = list(devices) if devices is not None else jax.devices()
+    spec = (spec or MeshSpec()).resolve(len(devices))
+    # jax.make_mesh picks a device order that keeps adjacent mesh
+    # coordinates ICI-adjacent where it can; fall back to reshape for
+    # explicit device lists.
+    if devices == jax.devices():
+        return jax.make_mesh(spec.shape, AxisName.ALL)
+    arr = np.asarray(devices).reshape(spec.shape)
+    return Mesh(arr, AxisName.ALL)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a [batch, ...] array: batch split over (data, fsdp);
+    trailing dims replicated (PartitionSpec leaves them unlisted).
+
+    The analogue of `DistributedSampler` handing each rank a disjoint
+    shard (distributed_utils.py:151) — except here a single global array
+    is laid out across devices and XLA keeps every computation local to
+    its shard.
+    """
+    return NamedSharding(mesh, P(AxisName.BATCH))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def global_batch_size(per_device: int, mesh: Mesh) -> int:
+    n = mesh.shape[AxisName.DATA] * mesh.shape[AxisName.FSDP]
+    return per_device * n

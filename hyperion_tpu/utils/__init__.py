@@ -1,0 +1,2 @@
+from hyperion_tpu.utils.timing import time_fn, TimingResult, sync  # noqa: F401
+from hyperion_tpu.utils.memory import device_memory_stats, peak_bytes_in_use, live_bytes_in_use  # noqa: F401

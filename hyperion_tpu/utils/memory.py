@@ -1,0 +1,31 @@
+"""Device-memory accounting — the torch.cuda memory-counter analogue.
+
+Reference reads `memory_allocated` / `max_memory_allocated` /
+`reset_peak_memory_stats` throughout its benchmarks
+(`baseline_performance.ipynb cell 0:158-162`,
+`01_hardware_exploration.ipynb cell 1:25-32`). The TPU equivalents come
+from the PJRT allocator via `device.memory_stats()`; CPU (test) backends
+may not implement them, so every reader degrades to 0 rather than
+raising — benchmarks still run, memory columns read 0.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def device_memory_stats(device: jax.Device | None = None) -> dict:
+    device = device or jax.devices()[0]
+    try:
+        return dict(device.memory_stats() or {})
+    except Exception:  # noqa: BLE001 — backend without allocator stats
+        return {}
+
+
+def live_bytes_in_use(device: jax.Device | None = None) -> int:
+    return int(device_memory_stats(device).get("bytes_in_use", 0))
+
+
+def peak_bytes_in_use(device: jax.Device | None = None) -> int:
+    s = device_memory_stats(device)
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))

@@ -1,0 +1,69 @@
+"""Honest wall-clock timing under JAX's async dispatch.
+
+Reference timing brackets every measurement with
+`torch.cuda.synchronize()` (`Phase 1/benchmarking.py:37-49`,
+`compilation_optimization.py:105-111`). JAX dispatches asynchronously, so
+naive `time.perf_counter()` around a jitted call measures dispatch, not
+compute — every timer here fences with `jax.block_until_ready` on the
+full output tree (SURVEY §7.3 "epoch-duration parity metrics").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+
+def sync(tree: Any = None) -> None:
+    """Fence: wait for `tree` (or all in-flight work) to finish."""
+    if tree is None:
+        jax.effects_barrier()
+    else:
+        jax.block_until_ready(tree)
+
+
+@dataclasses.dataclass
+class TimingResult:
+    mean_ms: float
+    std_ms: float
+    min_ms: float
+    median_ms: float
+    iters: int
+    times_ms: list[float]
+
+    def throughput(self, items_per_call: int) -> float:
+        """items/s at the mean latency (reference computes samples/s the
+        same way — baseline_performance.ipynb cell 0:164-166)."""
+        return items_per_call / (self.mean_ms / 1e3)
+
+
+def time_fn(
+    fn: Callable[..., Any],
+    *args: Any,
+    warmup: int = 3,
+    iters: int = 20,
+    **kwargs: Any,
+) -> TimingResult:
+    """Time ``fn(*args)`` with warmup (absorbs compilation) and
+    block_until_ready fencing per iteration."""
+    for _ in range(warmup):
+        sync(fn(*args, **kwargs))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        sync(out)
+        times.append((time.perf_counter() - t0) * 1e3)
+    arr = np.asarray(times)
+    return TimingResult(
+        mean_ms=float(arr.mean()),
+        std_ms=float(arr.std()),
+        min_ms=float(arr.min()),
+        median_ms=float(np.median(arr)),
+        iters=iters,
+        times_ms=times,
+    )

@@ -1,0 +1,52 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperion_tpu.precision import Policy, apply_remat, get_policy
+from hyperion_tpu.precision.policy import POLICIES
+
+
+class TestPolicy:
+    def test_registry(self):
+        for name in ("fp32", "bf16", "bf16_full"):
+            assert get_policy(name).name == name
+        with pytest.raises(ValueError):
+            get_policy("fp16_scaled")
+
+    def test_bf16_casts_compute_keeps_master_fp32(self):
+        p = get_policy("bf16")
+        tree = {"w": jnp.ones((2, 2), jnp.float32), "step": jnp.array(3, jnp.int32)}
+        c = p.cast_to_compute(tree)
+        assert c["w"].dtype == jnp.bfloat16
+        assert c["step"].dtype == jnp.int32  # non-float leaves untouched
+        assert p.cast_to_param(c)["w"].dtype == jnp.float32
+
+    def test_bf16_full_matches_fsdp_mixed_precision(self):
+        p = get_policy("bf16_full")
+        assert p.param_dtype == p.compute_dtype == p.reduce_dtype == jnp.bfloat16
+
+    def test_identity_passthrough(self):
+        assert isinstance(get_policy(POLICIES["fp32"]), Policy)
+
+
+class TestRemat:
+    def test_grad_equivalence(self):
+        def f(x):
+            for _ in range(3):
+                x = jnp.tanh(x @ x)
+            return x.sum()
+
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 8)), jnp.float32)
+        g_plain = jax.grad(f)(x)
+        for policy in ("full", "dots", "dots_no_batch"):
+            g_remat = jax.grad(apply_remat(f, policy))(x)
+            np.testing.assert_allclose(g_plain, g_remat, rtol=1e-5)
+
+    def test_none_is_identity(self):
+        f = lambda x: x * 2
+        assert apply_remat(f, "none") is f
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            apply_remat(lambda x: x, "everything")

@@ -1,0 +1,79 @@
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from hyperion_tpu.runtime import dist
+from hyperion_tpu.runtime.comm_check import comm_check
+from hyperion_tpu.runtime.mesh import (
+    AxisName,
+    MeshSpec,
+    batch_sharding,
+    global_batch_size,
+    make_mesh,
+    replicated_sharding,
+)
+
+
+class TestMeshSpec:
+    def test_infer_axis(self):
+        assert MeshSpec(data=-1, fsdp=2).resolve(8).shape == (4, 2, 1, 1)
+
+    def test_explicit(self):
+        assert MeshSpec(data=2, fsdp=2, model=2).resolve(8).shape == (2, 2, 2, 1)
+
+    def test_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            MeshSpec(data=3).resolve(8)
+        with pytest.raises(ValueError):
+            MeshSpec(data=-1, fsdp=3).resolve(8)
+        with pytest.raises(ValueError):
+            MeshSpec(data=-1, fsdp=-1).resolve(8)
+
+
+class TestMesh:
+    def test_default_all_data(self, devices):
+        mesh = make_mesh()
+        assert mesh.shape[AxisName.DATA] == 8
+        assert mesh.shape[AxisName.FSDP] == 1
+
+    def test_axes_complete(self, mesh8):
+        assert set(mesh8.axis_names) == set(AxisName.ALL)
+        assert mesh8.shape[AxisName.DATA] == 2
+        assert mesh8.shape[AxisName.FSDP] == 4
+
+    def test_batch_sharding_spans_data_and_fsdp(self, mesh8):
+        s = batch_sharding(mesh8)
+        x = jax.device_put(np.zeros((16, 4), np.float32), s)
+        # batch split over data(2) x fsdp(4) = 8 shards of 2 rows
+        assert x.addressable_shards[0].data.shape == (2, 4)
+        assert global_batch_size(2, mesh8) == 16
+
+    def test_replicated(self, mesh8):
+        s = replicated_sharding(mesh8)
+        x = jax.device_put(np.ones((3,)), s)
+        assert x.addressable_shards[0].data.shape == (3,)
+        assert len(x.addressable_shards) == 8
+
+
+class TestDist:
+    def test_single_process_noop(self):
+        dist.setup()  # must be a no-op without multi-process env
+        assert dist.is_primary()
+        assert dist.process_count() == 1
+        dist.barrier()
+        dist.cleanup()
+
+
+class TestCommCheck:
+    def test_all_collectives_pass(self, devices):
+        assert comm_check(verbose=False)
+
+    def test_subset_ring(self, devices):
+        assert comm_check(devices=devices[:4], verbose=False)
+
+    def test_cli_exit_code(self, capsys):
+        from hyperion_tpu.runtime.comm_check import main
+
+        assert main() == 0
+        assert "ALL COLLECTIVES PASSED" in capsys.readouterr().out
