@@ -1,0 +1,82 @@
+"""Host-side batch sharding — the DistributedSampler + DataLoader analogue.
+
+Reference: every trainer builds `DistributedSampler(dataset, world, rank,
+shuffle=True)` + `DataLoader(batch_size, num_workers=2)` and calls
+`sampler.set_epoch(ep)` each epoch (`distributed_utils.py:151-152,168`).
+
+TPU-native shape: there is one *global* batch per step, laid out across
+the mesh with `jax.make_array_from_process_local_data` — each host
+materializes only the rows that live on its local devices, and XLA sees
+a single sharded array. Epoch shuffling is deterministic in
+(seed, epoch), the `set_epoch` semantics, identical on every host so
+the global permutation agrees without communication.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from hyperion_tpu.runtime.mesh import batch_sharding
+
+
+class ShardedBatches:
+    """Iterate dict-of-arrays data as mesh-sharded global batches.
+
+    drop_last semantics: the tail that doesn't fill a global batch is
+    dropped (the reference's DataLoader default for DDP training).
+    """
+
+    def __init__(
+        self,
+        arrays: dict[str, np.ndarray],
+        global_batch: int,
+        mesh: Mesh,
+        shuffle: bool = True,
+        seed: int = 0,
+    ):
+        lens = {k: v.shape[0] for k, v in arrays.items()}
+        if len(set(lens.values())) != 1:
+            raise ValueError(f"ragged arrays: {lens}")
+        self.arrays = arrays
+        self.n = next(iter(lens.values()))
+        if global_batch > self.n:
+            raise ValueError(f"global_batch {global_batch} > dataset size {self.n}")
+        self.global_batch = global_batch
+        self.mesh = mesh
+        self.shuffle = shuffle
+        self.seed = seed
+        self.sharding: NamedSharding = batch_sharding(mesh)
+        self.steps_per_epoch = self.n // global_batch
+
+    def epoch(self, epoch: int) -> Iterator[dict[str, jax.Array]]:
+        """One pass over the data; `epoch` feeds the permutation seed
+        (the sampler.set_epoch analogue)."""
+        order = np.arange(self.n)
+        if self.shuffle:
+            np.random.default_rng((self.seed, epoch)).shuffle(order)
+        for s in range(self.steps_per_epoch):
+            idx = order[s * self.global_batch : (s + 1) * self.global_batch]
+            yield {
+                k: self._make_global(v, idx) for k, v in self.arrays.items()
+            }
+
+    def _make_global(self, v: np.ndarray, idx: np.ndarray) -> jax.Array:
+        # make_array_from_callback hands each *addressable* shard exactly
+        # the rows it owns — on multi-host, every host sees the same
+        # global index permutation (seeded identically) but materializes
+        # only its local devices' slices. (make_array_from_process_local_data
+        # would instead treat the full global batch as per-process data
+        # and inflate the batch dimension by process_count.)
+        global_shape = (self.global_batch, *v.shape[1:])
+        return jax.make_array_from_callback(
+            global_shape,
+            self.sharding,
+            lambda i: np.ascontiguousarray(v[idx[i[0]]]),
+        )
+
+    def __len__(self) -> int:
+        return self.steps_per_epoch

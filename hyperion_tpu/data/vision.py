@@ -1,0 +1,138 @@
+"""CIFAR-10 pipeline — the C19 equivalent, NHWC for TPU.
+
+Reference: `dataset_preparation.ipynb cell 5:1-57` downloads CIFAR-10
+via torchvision, normalizes with mean/std (.5,.5,.5), filters invalid
+samples (shape == (3,32,32) and any-nonzero), and `torch.save`s lists of
+(img, label) tuples that trainers reload.
+
+TPU-native differences: images are **NHWC float32** (XLA's native conv
+layout on TPU — the reference's `channels_last` experiments,
+`compilation_optimization.py:78-79`, are the default here, not an
+optimization), and the on-disk source is the standard CIFAR-10 python
+pickle batches read directly with NumPy (no torchvision dependency),
+with a deterministic synthetic fallback for air-gapped machines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+from pathlib import Path
+
+import numpy as np
+
+CIFAR_SHAPE = (32, 32, 3)  # NHWC
+CIFAR_CLASSES = 10
+_MEAN = 0.5
+_STD = 0.5
+
+
+@dataclasses.dataclass
+class VisionSplit:
+    images: np.ndarray  # float32 [N, 32, 32, 3], normalized
+    labels: np.ndarray  # int32   [N]
+    source: str = "synthetic"
+
+    def __post_init__(self):
+        self.images = np.ascontiguousarray(self.images, dtype=np.float32)
+        self.labels = np.ascontiguousarray(self.labels, dtype=np.int32)
+        assert self.images.shape[0] == self.labels.shape[0]
+
+    def __len__(self) -> int:
+        return self.images.shape[0]
+
+    def arrays(self) -> dict[str, np.ndarray]:
+        return {"images": self.images, "labels": self.labels}
+
+    def verify(self) -> None:
+        """Reload-verify, mirroring cell 5:54-57's shape check."""
+        if len(self) == 0:
+            raise ValueError("empty split")
+        if self.images.shape[1:] != CIFAR_SHAPE:
+            raise ValueError(f"bad image shape {self.images.shape[1:]}")
+        if self.labels.min() < 0 or self.labels.max() >= CIFAR_CLASSES:
+            raise ValueError("labels outside [0,10)")
+
+
+def _normalize(u8_nchw: np.ndarray) -> np.ndarray:
+    """uint8 [N,3,32,32] → normalized float32 NHWC, the reference's
+    ToTensor + Normalize((.5,)*3, (.5,)*3) transform."""
+    x = u8_nchw.astype(np.float32) / 255.0
+    x = (x - _MEAN) / _STD
+    return x.transpose(0, 2, 3, 1)
+
+
+def filter_valid(raw_u8: np.ndarray, labels: np.ndarray):
+    """Validity filter from the reference (cell 5:20-24): keep images with
+    any nonzero pixel. Applied to the *raw uint8* data — the reference
+    checks after Normalize, where a normalized pixel can never be exactly
+    0 and the filter provably never fires (a bug not worth replicating)."""
+    keep = raw_u8.reshape(len(raw_u8), -1).max(axis=1) > 0
+    return raw_u8[keep], labels[keep]
+
+
+def load_cifar_batches(data_dir: str | Path) -> dict[str, "VisionSplit"]:
+    """Read the standard `cifar-10-batches-py` pickle files with NumPy."""
+    d = Path(data_dir)
+    train_imgs, train_labels = [], []
+    for i in range(1, 6):
+        with open(d / f"data_batch_{i}", "rb") as f:
+            b = pickle.load(f, encoding="bytes")
+        train_imgs.append(np.asarray(b[b"data"]).reshape(-1, 3, 32, 32))
+        train_labels.append(np.asarray(b[b"labels"]))
+    with open(d / "test_batch", "rb") as f:
+        b = pickle.load(f, encoding="bytes")
+    out = {}
+    for name, (imgs, labels) in {
+        "train": (np.concatenate(train_imgs), np.concatenate(train_labels)),
+        "test": (np.asarray(b[b"data"]).reshape(-1, 3, 32, 32), np.asarray(b[b"labels"])),
+    }.items():
+        raw, y = filter_valid(imgs, labels.astype(np.int32))
+        out[name] = VisionSplit(_normalize(raw), y, source=f"cifar:{d}")
+    return out
+
+
+def synthetic_cifar_split(n: int, seed: int = 0) -> VisionSplit:
+    """Deterministic class-structured synthetic CIFAR: each class gets a
+    distinct low-frequency template plus noise, so accuracy curves are
+    meaningful (a model can actually learn the mapping)."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:32, 0:32].astype(np.float32) / 32.0
+    templates = np.stack(
+        [
+            np.stack(
+                [
+                    np.sin(2 * np.pi * ((c % 3 + 1) * xx + (c // 3) * yy + c / 10 + ch / 3))
+                    for ch in range(3)
+                ],
+                axis=-1,
+            )
+            for c in range(CIFAR_CLASSES)
+        ]
+    )  # [10, 32, 32, 3]
+    labels = rng.integers(0, CIFAR_CLASSES, size=n).astype(np.int32)
+    images = templates[labels] * 0.5 + rng.normal(0, 0.3, size=(n, *CIFAR_SHAPE))
+    return VisionSplit(np.clip(images, -1, 1).astype(np.float32), labels)
+
+
+def load_cifar10(
+    base_dir: str | Path = "data",
+    synthetic_sizes: dict[str, int] | None = None,
+    seed: int = 0,
+) -> dict[str, VisionSplit]:
+    """Load CIFAR-10, preferring `{base}/cifar-10-batches-py`, falling
+    back to synthetic (default sizes 50000/10000 scaled down 10x)."""
+    d = Path(base_dir) / "cifar-10-batches-py"
+    if d.is_dir() and (d / "data_batch_1").exists():
+        out = load_cifar_batches(d)
+    else:
+        sizes = {"train": 5000, "test": 1000}
+        if synthetic_sizes:
+            sizes.update(synthetic_sizes)
+        out = {
+            name: synthetic_cifar_split(sz, seed=seed + i)
+            for i, (name, sz) in enumerate(sizes.items())
+        }
+    for s in out.values():
+        s.verify()
+    return out
