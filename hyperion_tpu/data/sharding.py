@@ -50,6 +50,12 @@ class ShardedBatches:
         self.shuffle = shuffle
         self.seed = seed
         self.sharding: NamedSharding = batch_sharding(mesh)
+        n_shards = int(np.prod([mesh.shape[a] for a in self.sharding.spec[0]]))
+        if global_batch % n_shards:
+            raise ValueError(
+                f"global_batch {global_batch} not divisible by the mesh's "
+                f"{n_shards} batch shards (data*fsdp axes of {dict(mesh.shape)})"
+            )
         self.steps_per_epoch = self.n // global_batch
 
     def epoch(self, epoch: int) -> Iterator[dict[str, jax.Array]]:

@@ -1,0 +1,21 @@
+"""Model zoo — capability parity with the reference's L3 (SURVEY §1).
+
+Reference models:
+  SimpleTransformerLM        distributed_utils.py:75-88
+  GPT-2-shaped LM variant    compilation_optimization.py:57-71
+  ResNet-18 (CIFAR-10)       distributed_utils.py:229
+  ResNet-50 / ViT-B/16       baseline_performance.ipynb cell 0:21-54
+  CustomTransformer          baseline_performance.ipynb cell 0:57-67
+  Llama-2-7B (+LoRA)         distributed_utils.py:463-500
+
+All are re-implemented as flax.linen modules in TPU-friendly layouts
+(bf16-ready, [B,T,H,D] attention, static shapes) — not translations.
+"""
+
+from hyperion_tpu.models.transformer_lm import (  # noqa: F401
+    TransformerLM,
+    TransformerLMConfig,
+    gpt2_lm_config,
+    simple_lm_config,
+)
+from hyperion_tpu.models.resnet import ResNet, resnet18, resnet50  # noqa: F401

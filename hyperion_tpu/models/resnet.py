@@ -1,0 +1,153 @@
+"""ResNet-18/50 — the reference's vision workloads.
+
+Reference: torchvision `resnet18(num_classes=10)` for CIFAR DDP training
+(`distributed_utils.py:229`) and `resnet50` for the baseline benchmark
+(`baseline_performance.ipynb cell 0:21-26`).
+
+TPU-first notes:
+  * NHWC layout throughout — the TPU-native conv layout (the reference
+    reaches for `channels_last` as an *optimization*,
+    `compilation_optimization.py:78-79`; on TPU it is simply the
+    natural layout).
+  * BatchNorm under `jit` over a sharded batch is **globally synced for
+    free**: batch-stat reductions are global-view means, so XLA inserts
+    the cross-device psum automatically — the SyncBN machinery DDP
+    users bolt on is unnecessary here. Stats live in the `batch_stats`
+    collection.
+  * `cifar_stem` swaps the 7x7/stride-2+maxpool ImageNet stem for the
+    3x3/stride-1 stem that makes ResNets work on 32x32 inputs (the
+    reference trains torchvision's ImageNet stem on CIFAR as-is, which
+    burns resolution; ours keeps both options).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: tuple[int, ...] = (2, 2, 2, 2)
+    bottleneck: bool = False
+    num_classes: int = 10
+    width: int = 64
+    cifar_stem: bool = True
+    dtype: str = "float32"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def _conv(features, kernel, strides=1, name=None, dtype=jnp.float32):
+    return nn.Conv(
+        features,
+        (kernel, kernel),
+        strides=(strides, strides),
+        padding="SAME",
+        use_bias=False,
+        dtype=dtype,
+        kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+        name=name,
+    )
+
+
+def _bn(train: bool, name=None, dtype=jnp.float32, scale_init=None):
+    return nn.BatchNorm(
+        use_running_average=not train,
+        momentum=0.9,
+        epsilon=1e-5,
+        dtype=dtype,
+        scale_init=scale_init or nn.initializers.ones,
+        name=name,
+    )
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        residual = x
+        y = _conv(self.features, 3, self.strides, "conv1", self.dtype)(x)
+        y = _bn(train, "bn1", self.dtype)(y)
+        y = nn.relu(y)
+        y = _conv(self.features, 3, 1, "conv2", self.dtype)(y)
+        # zero-init the last BN scale: residual branch starts as identity
+        # (the standard trick torchvision enables via zero_init_residual)
+        y = _bn(train, "bn2", self.dtype, nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = _conv(self.features, 1, self.strides, "down_conv", self.dtype)(x)
+            residual = _bn(train, "down_bn", self.dtype)(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        residual = x
+        y = _conv(self.features, 1, 1, "conv1", self.dtype)(x)
+        y = _bn(train, "bn1", self.dtype)(y)
+        y = nn.relu(y)
+        y = _conv(self.features, 3, self.strides, "conv2", self.dtype)(y)
+        y = _bn(train, "bn2", self.dtype)(y)
+        y = nn.relu(y)
+        y = _conv(self.features * 4, 1, 1, "conv3", self.dtype)(y)
+        y = _bn(train, "bn3", self.dtype, nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = _conv(self.features * 4, 1, self.strides, "down_conv", self.dtype)(x)
+            residual = _bn(train, "down_bn", self.dtype)(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        """images: [B, H, W, 3] NHWC → logits fp32 [B, num_classes]."""
+        c = self.cfg
+        dt = c.compute_dtype
+        x = images.astype(dt)
+        if c.cifar_stem:
+            x = _conv(c.width, 3, 1, "stem_conv", dt)(x)
+            x = _bn(train, "stem_bn", dt)(x)
+            x = nn.relu(x)
+        else:
+            x = _conv(c.width, 7, 2, "stem_conv", dt)(x)
+            x = _bn(train, "stem_bn", dt)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        block_cls = BottleneckBlock if c.bottleneck else BasicBlock
+        for stage, n_blocks in enumerate(c.stage_sizes):
+            for b in range(n_blocks):
+                strides = 2 if stage > 0 and b == 0 else 1
+                x = block_cls(
+                    c.width * (2 ** stage), strides, dt, name=f"stage{stage}_block{b}"
+                )(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(c.num_classes, dtype=dt, name="fc")(x)
+        return logits.astype(jnp.float32)
+
+    def init_variables(self, rng, image_size: int = 32, batch: int = 2):
+        imgs = jnp.zeros((batch, image_size, image_size, 3), jnp.float32)
+        return self.init(rng, imgs, train=False)
+
+
+def resnet18(num_classes: int = 10, cifar_stem: bool = True, dtype: str = "float32") -> ResNet:
+    return ResNet(ResNetConfig((2, 2, 2, 2), False, num_classes, cifar_stem=cifar_stem, dtype=dtype))
+
+
+def resnet50(num_classes: int = 1000, cifar_stem: bool = False, dtype: str = "float32") -> ResNet:
+    return ResNet(ResNetConfig((3, 4, 6, 3), True, num_classes, cifar_stem=cifar_stem, dtype=dtype))

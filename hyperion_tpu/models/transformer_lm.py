@@ -1,0 +1,163 @@
+"""Transformer language models (toy + GPT-2-shaped).
+
+Capability parity targets:
+  * `SimpleTransformerLM` — emb 256, 4 heads, 2 encoder layers, GPT-2
+    vocab 50257 (`distributed_utils.py:75-88`) → `simple_lm_config()`.
+  * the compile-benchmark GPT-2-shaped variant — d_model 768, 4 layers,
+    12 heads, ff 3072, GELU (`compilation_optimization.py:57-71`)
+    → `gpt2_lm_config()`.
+
+TPU-first design choices (deliberately not a torch translation):
+  * pre-LayerNorm blocks (stable in bf16 without warmup tricks; the
+    torch default is post-LN),
+  * attention in [B, T, H, D] layout via `hyperion_tpu.ops.attention`
+    so the seq axis can shard for ring attention,
+  * causal masking in-model (the reference shifts inputs/targets but
+    its encoder attends bidirectionally — a known quirk of the
+    reference's toy; ours is a true causal LM, strictly better),
+  * optional `jax.checkpoint` rematerialisation per block — the
+    activation-checkpointing analogue (`memory_optimization.ipynb
+    cell 3:16-18`) expressed as a compiler policy, not an API wrapper.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from hyperion_tpu.data.text import GPT2_VOCAB_SIZE
+from hyperion_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerLMConfig:
+    vocab_size: int = GPT2_VOCAB_SIZE
+    d_model: int = 256
+    n_heads: int = 4
+    n_layers: int = 2
+    ff_dim: int = 1024
+    max_len: int = 128
+    dropout: float = 0.1
+    activation: str = "relu"       # relu | gelu
+    attention_impl: str = "xla"    # xla | pallas
+    remat: bool = False            # jax.checkpoint each block
+    dtype: str = "float32"         # compute dtype; params stay fp32
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def simple_lm_config(**kw) -> TransformerLMConfig:
+    return TransformerLMConfig(**kw)
+
+
+def gpt2_lm_config(**kw) -> TransformerLMConfig:
+    base = dict(d_model=768, n_heads=12, n_layers=4, ff_dim=3072, activation="gelu")
+    base.update(kw)
+    return TransformerLMConfig(**base)
+
+
+class MHA(nn.Module):
+    cfg: TransformerLMConfig
+
+    @nn.compact
+    def __call__(self, x, padding_mask, deterministic: bool):
+        c = self.cfg
+        B, T, _ = x.shape
+        dense = partial(
+            nn.DenseGeneral,
+            features=(c.n_heads, c.head_dim),
+            dtype=c.compute_dtype,
+            kernel_init=nn.initializers.xavier_uniform(),
+        )
+        q = dense(name="q_proj")(x)
+        k = dense(name="k_proj")(x)
+        v = dense(name="v_proj")(x)
+        out = dot_product_attention(
+            q, k, v, causal=True, padding_mask=padding_mask, impl=c.attention_impl
+        )
+        return nn.DenseGeneral(
+            features=c.d_model,
+            axis=(-2, -1),
+            dtype=c.compute_dtype,
+            kernel_init=nn.initializers.xavier_uniform(),
+            name="o_proj",
+        )(out)
+
+
+class Block(nn.Module):
+    cfg: TransformerLMConfig
+
+    @nn.compact
+    def __call__(self, x, padding_mask, deterministic: bool):
+        c = self.cfg
+        act = {"relu": nn.relu, "gelu": nn.gelu}[c.activation]
+        h = nn.LayerNorm(dtype=c.compute_dtype, name="ln1")(x)
+        h = MHA(c, name="attn")(h, padding_mask, deterministic)
+        h = nn.Dropout(c.dropout, deterministic=deterministic)(h)
+        x = x + h
+        h = nn.LayerNorm(dtype=c.compute_dtype, name="ln2")(x)
+        h = nn.Dense(c.ff_dim, dtype=c.compute_dtype, name="fc1")(h)
+        h = act(h)
+        h = nn.Dense(c.d_model, dtype=c.compute_dtype, name="fc2")(h)
+        h = nn.Dropout(c.dropout, deterministic=deterministic)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    cfg: TransformerLMConfig
+
+    @nn.compact
+    def __call__(self, input_ids, padding_mask=None, deterministic: bool = True):
+        """input_ids: int32 [B, T] → logits fp32 [B, T, vocab]."""
+        c = self.cfg
+        T = input_ids.shape[1]
+        if T > c.max_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_len {c.max_len} — the "
+                f"positional table has no rows past max_len"
+            )
+        x = nn.Embed(
+            c.vocab_size,
+            c.d_model,
+            dtype=c.compute_dtype,
+            embedding_init=nn.initializers.normal(0.02),
+            name="tok_emb",
+        )(input_ids)
+        pos = nn.Embed(
+            c.max_len,
+            c.d_model,
+            dtype=c.compute_dtype,
+            embedding_init=nn.initializers.normal(0.02),
+            name="pos_emb",
+        )(jnp.arange(T, dtype=jnp.int32))
+        x = x + pos[None]
+        x = nn.Dropout(c.dropout, deterministic=deterministic)(x)
+
+        block = Block
+        if c.remat:
+            block = nn.remat(Block, static_argnums=(2,))
+        for i in range(c.n_layers):
+            x = block(c, name=f"block_{i}")(x, padding_mask, deterministic)
+        x = nn.LayerNorm(dtype=c.compute_dtype, name="ln_f")(x)
+        logits = nn.Dense(
+            c.vocab_size,
+            dtype=c.compute_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name="lm_head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+    def init_params(self, rng: jax.Array, batch: int = 2):
+        ids = jnp.zeros((batch, self.cfg.max_len), jnp.int32)
+        return self.init(rng, ids)["params"]

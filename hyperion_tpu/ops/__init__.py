@@ -1,0 +1,9 @@
+"""Core compute ops: attention, norms — XLA-first with Pallas tiers.
+
+The reference gets its kernels from the external stack (rocBLAS matmul,
+MIOpen conv, Inductor/Triton fusion — SURVEY §2.3). Here the ops live
+in-tree: a plain-XLA implementation (jit fusion is the default tier) and
+Pallas TPU kernels as the tuned tier (`compile_tier="jit+pallas"`).
+"""
+
+from hyperion_tpu.ops.attention import dot_product_attention  # noqa: F401

@@ -1,0 +1,99 @@
+"""Multi-head scaled-dot-product attention — the framework's hot op.
+
+The reference leans on `torch.nn.TransformerEncoder` (its attention runs
+in rocBLAS/MIOpen — `distributed_utils.py:75-88`) and on HF Llama's
+attention for the 7B path (`distributed_utils.py:465-467`). Here the op
+is in-tree with selectable implementations:
+
+  impl="xla"     einsum formulation; XLA fuses softmax into the matmuls
+                 and tiles them onto the MXU. The default tier.
+  impl="pallas"  in-tree flash-attention Pallas kernel
+                 (hyperion_tpu.ops.pallas.flash_attention) — the
+                 Inductor/Triton "max-autotune" analogue.
+
+Shapes follow the TPU-friendly [batch, seq, heads, head_dim] layout so
+the seq axis can later be sharded for ring attention (SURVEY §5.7).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -2.0 ** 30  # large-but-finite: keeps bf16 softmax NaN-free
+
+
+def causal_mask(q_len: int, kv_len: int, dtype=jnp.bool_) -> jax.Array:
+    """[q_len, kv_len] lower-triangular mask (True = attend), aligned to
+    the *end* of the kv sequence (supports queries shorter than kv, as in
+    decode steps)."""
+    offset = kv_len - q_len
+    q_pos = lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 0) + offset
+    kv_pos = lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 1)
+    return (kv_pos <= q_pos).astype(dtype)
+
+
+def _xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array | None,
+) -> jax.Array:
+    # q: [B, Tq, H, D]; k/v: [B, Tkv, H, D]; mask: broadcastable to
+    # [B, H, Tq, Tkv], True = attend.
+    depth = q.shape[-1]
+    # scale q in the compute dtype (rounding here is below the bf16
+    # matmul's own quantization noise); the MXU accumulates in fp32
+    scale = jnp.asarray(1.0 / jnp.sqrt(depth), q.dtype)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.asarray(NEG_INF, logits.dtype))
+    # softmax in fp32 regardless of compute dtype (bf16 softmax loses
+    # precision exactly where attention needs it)
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    padding_mask: jax.Array | None = None,
+    impl: str = "xla",
+) -> jax.Array:
+    """Attention over [batch, seq, heads, head_dim] tensors.
+
+    padding_mask: [B, Tkv] with 1 = real token, 0 = pad (the reference's
+    `attention_mask` column — dataset_preparation.ipynb cell 3).
+    """
+    if q.ndim != 4 or k.shape != v.shape or q.shape[-1] != k.shape[-1]:
+        raise ValueError(f"bad attention shapes q={q.shape} k={k.shape} v={v.shape}")
+    if impl == "pallas":
+        try:
+            from hyperion_tpu.ops.pallas.flash_attention import flash_attention
+        except ModuleNotFoundError as e:
+            raise NotImplementedError(
+                "the pallas attention tier is not built yet; use impl='xla'"
+            ) from e
+        return flash_attention(q, k, v, causal=causal, padding_mask=padding_mask)
+    if impl != "xla":
+        raise ValueError(f"unknown attention impl {impl!r}")
+
+    mask = None
+    if causal:
+        mask = causal_mask(q.shape[1], k.shape[1])[None, None]
+    if padding_mask is not None:
+        pad = padding_mask[:, None, None, :].astype(jnp.bool_)
+        mask = pad if mask is None else jnp.logical_and(mask, pad)
+    return _xla_attention(q, k, v, mask)
+
+
+@functools.partial(jax.jit, static_argnames=("causal",))
+def reference_attention(q, k, v, causal: bool = False):
+    """Tiny jitted convenience wrapper used by kernel correctness tests."""
+    return dot_product_attention(q, k, v, causal=causal)
