@@ -1,0 +1,17 @@
+"""Parallelism as sharding layout: DP / FSDP / TP specs over the mesh."""
+
+from hyperion_tpu.parallel.partition import (
+    TRANSFORMER_TP_RULES,
+    named_shardings,
+    partition_specs,
+    shard_params,
+    shardings_like,
+)
+
+__all__ = [
+    "TRANSFORMER_TP_RULES",
+    "named_shardings",
+    "partition_specs",
+    "shard_params",
+    "shardings_like",
+]

@@ -84,13 +84,19 @@ def make_mesh(
     world with one DDP rank per GPU."""
     devices = list(devices) if devices is not None else jax.devices()
     spec = (spec or MeshSpec()).resolve(len(devices))
+    # Auto axis types = GSPMD mode: shardings are layout hints and XLA's
+    # partitioner resolves every op + inserts collectives (jax 0.9 defaults
+    # make_mesh to Explicit, the sharding-in-types mode, which instead
+    # rejects ops whose output sharding is ambiguous — e.g. embedding
+    # gathers of a batch-sharded index into an fsdp-sharded table).
+    auto = (jax.sharding.AxisType.Auto,) * len(AxisName.ALL)
     # jax.make_mesh picks a device order that keeps adjacent mesh
     # coordinates ICI-adjacent where it can; fall back to reshape for
     # explicit device lists.
     if devices == jax.devices():
-        return jax.make_mesh(spec.shape, AxisName.ALL)
+        return jax.make_mesh(spec.shape, AxisName.ALL, axis_types=auto)
     arr = np.asarray(devices).reshape(spec.shape)
-    return Mesh(arr, AxisName.ALL)
+    return Mesh(arr, AxisName.ALL, axis_types=auto)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
