@@ -1,0 +1,53 @@
+"""Headline benchmark — one JSON line for the round driver.
+
+Metric: sustained bf16 matmul TFLOPS at 8192x8192x8192 on one chip — the
+reference's own headline microbenchmark (MI250X: 121.07 TFLOPS bf16 at
+8192^2, `Phase 1/results/benchmarks/hardware/precision_results.csv:13`;
+BASELINE.md). `vs_baseline` is achieved/baseline, so 1.0 = parity.
+
+Unlike the reference's sweep (single un-warmed timing including
+allocation — SURVEY §6 caveats), this warms up, runs several fenced
+iterations, and reports the median.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_TFLOPS_BF16_8192 = 121.07  # MI250X bf16 8192^2 (BASELINE.md)
+N = 8192
+ITERS = 10
+
+
+def main() -> None:
+    k0, k1 = jax.random.split(jax.random.key(0))
+    a = jax.random.normal(k0, (N, N), jnp.bfloat16)
+    b = jax.random.normal(k1, (N, N), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a, b):
+        return a @ b
+
+    mm(a, b).block_until_ready()  # compile + warm
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        mm(a, b).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    t = statistics.median(times)
+    tflops = (2 * N**3 / t) / 1e12
+    print(json.dumps({
+        "metric": "matmul_bf16_8192_tflops",
+        "value": round(tflops, 2),
+        "unit": "TFLOPS",
+        "vs_baseline": round(tflops / BASELINE_TFLOPS_BF16_8192, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

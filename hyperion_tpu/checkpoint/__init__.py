@@ -1,0 +1,11 @@
+"""Sharded checkpointing with resume (exceeds the reference's save-only)."""
+
+from hyperion_tpu.checkpoint.io import (
+    export_gathered,
+    latest_step,
+    load_gathered,
+    restore,
+    save,
+)
+
+__all__ = ["export_gathered", "latest_step", "load_gathered", "restore", "save"]

@@ -1,0 +1,110 @@
+"""Checkpointing: sharded save/restore + gathered export + real resume.
+
+Reference (SURVEY §5.4): save-only, end-of-run. DDP does a rank-0
+`torch.save(model.module.state_dict())` (`distributed_utils.py:195-199`);
+FSDP gathers FULL_STATE_DICT to rank-0 CPU with a SHARDED_STATE_DICT
+fallback (`:374-405`). There is NO resume path anywhere in the reference.
+
+TPU-native shape, exceeding that:
+  * `save` / `restore`   — orbax sharded checkpoints: every host writes
+    its own shards (the SHARDED_STATE_DICT analogue, but the *primary*
+    path, not the fallback — gathering a sharded model to one host is the
+    thing that OOMs, as the reference's try/except tacitly admits).
+    Restore takes a sharding tree, so a checkpoint written on one mesh
+    reshards onto another.
+  * `export_gathered`    — full params gathered to host and written as a
+    single `.npz` (the FULL_STATE_DICT/rank0 analogue) for interchange.
+  * `latest_step` + step-numbered directories — actual resume.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+from flax import traverse_util
+
+from hyperion_tpu.runtime import dist
+from hyperion_tpu.train.state import TrainState
+
+_STEP_DIR = re.compile(r"^step_(\d+)$")
+
+
+def _step_path(root: str | Path, step: int) -> Path:
+    return Path(root).absolute() / f"step_{step:08d}"
+
+
+def save(root: str | Path, state: TrainState, force: bool = False) -> Path:
+    """Write a sharded checkpoint at the state's current step."""
+    step = int(state.step)
+    path = _step_path(root, step)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state, force=force)
+    return path
+
+
+def latest_step(root: str | Path) -> int | None:
+    root = Path(root)
+    if not root.is_dir():
+        return None
+    steps = [
+        int(m.group(1))
+        for p in root.iterdir()
+        if (m := _STEP_DIR.match(p.name)) and not p.name.endswith(".tmp")
+    ]
+    return max(steps, default=None)
+
+
+def restore(
+    root: str | Path, template: TrainState, step: int | None = None
+) -> TrainState | None:
+    """Restore the latest (or given) step directly into the template's
+    sharding — each device reads only the shards it owns, so restore
+    scales like sharded save did. `template` is a freshly-initialized
+    state (the trainer builds one anyway); a checkpoint written on a
+    different mesh reshards onto the template's. Returns None when there
+    is nothing to restore (fresh run)."""
+    step = step if step is not None else latest_step(root)
+    if step is None:
+        return None
+    target = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        template,
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(_step_path(root, step), target)
+
+
+def export_gathered(path: str | Path, params: Any) -> Path | None:
+    """Gather full (unsharded) params to host and write one `.npz` — the
+    FULL_STATE_DICT-to-rank-0 analogue (distributed_utils.py:374-386).
+    Every process participates in the gather (multi-host shards are not
+    locally addressable, so the collective must run everywhere); only the
+    primary writes, returning None elsewhere."""
+
+    def to_host(v):
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            v = multihost_utils.process_allgather(v, tiled=True)
+        return np.asarray(jax.device_get(v))
+
+    flat = traverse_util.flatten_dict(params, sep="/")
+    gathered = {k: to_host(v) for k, v in flat.items()}
+    if not dist.is_primary():
+        return None
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    np.savez(path, **gathered)
+    return path
+
+
+def load_gathered(path: str | Path) -> dict:
+    """Read an exported `.npz` back into a nested param dict."""
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files}
+    return traverse_util.unflatten_dict(flat, sep="/")

@@ -78,6 +78,7 @@ class TrainConfig:
     learning_rate: float = 2e-4
     weight_decay: float = 0.0
     seq_len: int = 128               # reference tokenization window
+    steps_per_epoch: int = 0         # 0 = full pass; >0 caps steps (smoke/bench runs)
     seed: int = 0
     base_dir: str = "data"
     log_every: int = 50

@@ -1,0 +1,5 @@
+"""Metrics logging and scaling reports."""
+
+from hyperion_tpu.metrics.csv_logger import SCHEMAS, CsvLogger, run_id
+
+__all__ = ["SCHEMAS", "CsvLogger", "run_id"]

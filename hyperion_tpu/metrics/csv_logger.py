@@ -1,0 +1,79 @@
+"""Per-epoch CSV metrics logging — the reference's observability backbone.
+
+Reference (SURVEY §5.5): rank-0 appends one CSV row per epoch; run ids are
+`{job}_{world}gpus_{timestamp}` (`distributed_utils.py:140,215,301,438`);
+schemas per trainer:
+    LM (DDP/FSDP):  epoch, loss, duration_s, gpus        (:147,306)
+    CIFAR:          epoch, loss, accuracy, duration_s, gpus  (:222)
+    Llama:          epoch, loss, duration_s, gpus, mode  (:442-444)
+Artifacts land in `{base_dir}/distributed/{run_id}_metrics.csv` and feed
+`create_scaling_report`. We keep the format byte-compatible (same columns,
+same filename shape) so the reference's downstream tooling — and ours —
+reads either. "gpus" is kept as the column name for that compatibility;
+on TPU it counts chips.
+"""
+
+from __future__ import annotations
+
+import csv
+import datetime
+from pathlib import Path
+
+from hyperion_tpu.runtime import dist
+
+SCHEMAS: dict[str, tuple[str, ...]] = {
+    "language_ddp": ("epoch", "loss", "duration_s", "gpus"),
+    "language_fsdp": ("epoch", "loss", "duration_s", "gpus"),
+    "cifar_ddp": ("epoch", "loss", "accuracy", "duration_s", "gpus"),
+    "llama": ("epoch", "loss", "duration_s", "gpus", "mode"),
+}
+
+
+def run_id(job: str, n_devices: int, when: datetime.datetime | None = None) -> str:
+    """`{job}_{n}gpus_{YYYYmmdd_HHMMSS}` — the reference's run-id format."""
+    when = when or datetime.datetime.now()
+    return f"{job}_{n_devices}gpus_{when:%Y%m%d_%H%M%S}"
+
+
+class CsvLogger:
+    """Append-per-epoch CSV writer, active only on the primary process
+    (the reference's `if rank == 0:` guard around every CSV touch)."""
+
+    def __init__(
+        self,
+        job: str,
+        n_devices: int,
+        base_dir: str | Path = "data",
+        schema: tuple[str, ...] | None = None,
+        run: str | None = None,
+    ):
+        self.job = job
+        self.schema = schema or SCHEMAS.get(job)
+        if self.schema is None:
+            raise ValueError(f"no schema for job {job!r}; pass schema=")
+        self.active = dist.is_primary()
+        self.run = run or run_id(job, n_devices)
+        self.path = Path(base_dir) / "distributed" / f"{self.run}_metrics.csv"
+        if self.active:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            with self.path.open("w", newline="") as f:
+                csv.writer(f).writerow(self.schema)
+
+    def log(self, **row) -> None:
+        if not self.active:
+            return
+        missing = set(self.schema) - row.keys()
+        if missing:
+            raise ValueError(f"row missing columns {sorted(missing)}")
+        with self.path.open("a", newline="") as f:
+            csv.writer(f).writerow([_fmt(row[c]) for c in self.schema])
+
+    def read(self) -> list[dict]:
+        with self.path.open() as f:
+            return list(csv.DictReader(f))
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6f}"
+    return str(v)

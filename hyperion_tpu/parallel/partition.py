@@ -55,14 +55,16 @@ Rule = tuple[str, P]
 # column-parallel and down row-parallel. XLA inserts the psum after
 # row-parallel matmuls on its own.
 TRANSFORMER_TP_RULES: tuple[Rule, ...] = (
-    (r".*/(q_proj|k_proj|v_proj)/kernel$", P(None, AxisName.MODEL, None)),
-    (r".*/(q_proj|k_proj|v_proj)/bias$", P(AxisName.MODEL, None)),
-    (r".*/o_proj/kernel$", P(AxisName.MODEL, None, None)),
-    (r".*/(fc1|up_proj|gate_proj)/kernel$", P(None, AxisName.MODEL)),
-    (r".*/(fc1|up_proj|gate_proj)/bias$", P(AxisName.MODEL)),
-    (r".*/(fc2|down_proj)/kernel$", P(AxisName.MODEL, None)),
-    (r".*/lm_head/kernel$", P(None, AxisName.MODEL)),
-    (r".*/(tok_emb|embed_tokens)/embedding$", P(None, AxisName.MODEL)),
+    # `(?:.*/)?` so the rule matches both nested params (block_0/attn/
+    # q_proj/kernel) and root-level ones (lm_head/kernel, tok_emb/embedding)
+    (r"(?:.*/)?(q_proj|k_proj|v_proj)/kernel$", P(None, AxisName.MODEL, None)),
+    (r"(?:.*/)?(q_proj|k_proj|v_proj)/bias$", P(AxisName.MODEL, None)),
+    (r"(?:.*/)?o_proj/kernel$", P(AxisName.MODEL, None, None)),
+    (r"(?:.*/)?(fc1|up_proj|gate_proj)/kernel$", P(None, AxisName.MODEL)),
+    (r"(?:.*/)?(fc1|up_proj|gate_proj)/bias$", P(AxisName.MODEL)),
+    (r"(?:.*/)?(fc2|down_proj)/kernel$", P(AxisName.MODEL, None)),
+    (r"(?:.*/)?lm_head/kernel$", P(None, AxisName.MODEL)),
+    (r"(?:.*/)?(tok_emb|embed_tokens)/embedding$", P(None, AxisName.MODEL)),
 )
 
 

@@ -1,0 +1,29 @@
+"""Training: jit-compiled steps and epoch drivers."""
+
+from hyperion_tpu.train.losses import classification_loss, next_token_loss
+from hyperion_tpu.train.state import (
+    StateSharding,
+    TrainState,
+    create_train_state,
+    make_optimizer,
+)
+from hyperion_tpu.train.step import make_eval_step, make_train_step
+from hyperion_tpu.train.trainer import (
+    TrainResult,
+    train_cifar_model,
+    train_language_model,
+)
+
+__all__ = [
+    "StateSharding",
+    "TrainState",
+    "TrainResult",
+    "classification_loss",
+    "create_train_state",
+    "make_eval_step",
+    "make_optimizer",
+    "make_train_step",
+    "next_token_loss",
+    "train_cifar_model",
+    "train_language_model",
+]

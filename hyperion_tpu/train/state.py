@@ -1,0 +1,120 @@
+"""Train state: params + optimizer state + BN stats, laid out on the mesh.
+
+The reference's equivalent is implicit — model params live inside the
+DDP/FSDP wrapper, optimizer state inside `torch.optim.AdamW`, and the
+layout (replicated vs sharded) is a property of which wrapper was used.
+Here the state is one explicit pytree whose leaves carry `NamedSharding`s,
+so the same `TrainState` serves DP (all-replicated), FSDP (param/opt
+sharded), and TP — the difference is only the sharding tree built by
+`hyperion_tpu.parallel`.
+
+Init is performed *under jit with out_shardings* so a model too big for
+one host is born sharded (FSDP materialized params shard-by-shard at wrap
+time for the same reason — distributed_utils.py:328-332).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import jax
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hyperion_tpu.parallel.partition import (
+    Rule,
+    named_shardings,
+    shardings_like,
+)
+from hyperion_tpu.precision.policy import Policy, get_policy
+
+
+@struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    batch_stats: Any  # {} for models without BN
+
+
+@dataclasses.dataclass(frozen=True)
+class StateSharding:
+    """Sharding pytree mirroring TrainState, plus the mesh it lives on."""
+
+    mesh: Mesh
+    tree: TrainState  # leaves are NamedShardings
+
+    @property
+    def params(self):
+        return self.tree.params
+
+
+def make_optimizer(
+    learning_rate: float,
+    weight_decay: float = 0.0,
+    grad_clip_norm: float = 0.0,
+    b1: float = 0.9,
+    b2: float = 0.999,
+) -> optax.GradientTransformation:
+    """AdamW matching the reference's optimizers (AdamW everywhere —
+    distributed_utils.py:161,231,334,503) with optional global-norm
+    clipping (the FSDP loops' clip_grad_norm_(1.0), :351,522)."""
+    steps = []
+    if grad_clip_norm and grad_clip_norm > 0:
+        steps.append(optax.clip_by_global_norm(grad_clip_norm))
+    steps.append(
+        optax.adamw(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay)
+    )
+    return optax.chain(*steps)
+
+
+def create_train_state(
+    init_variables: Callable[[jax.Array], dict],
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    rng: jax.Array,
+    policy: str | Policy = "bf16",
+    tp_rules: Sequence[Rule] | None = None,
+    fsdp: bool = True,
+    fsdp_min_size: int = 2**14,
+) -> tuple[TrainState, StateSharding]:
+    """Build a sharded TrainState.
+
+    `init_variables(rng)` returns the flax variables dict (params [+
+    batch_stats]). The state is created *on-device, already sharded*:
+    shapes come from `jax.eval_shape`, shardings from the parallel layer,
+    and the actual init runs under jit with those out_shardings.
+    """
+    policy = get_policy(policy)
+
+    def build(rng):
+        variables = init_variables(rng)
+        params = policy.cast_to_param(variables["params"])
+        batch_stats = variables.get("batch_stats", {})
+        opt_state = optimizer.init(params)
+        return TrainState(
+            step=jax.numpy.zeros((), jax.numpy.int32),
+            params=params,
+            opt_state=opt_state,
+            batch_stats=batch_stats,
+        )
+
+    shapes = jax.eval_shape(build, rng)
+    params_sh = named_shardings(
+        shapes.params, mesh, tp_rules=tp_rules, fsdp=fsdp, fsdp_min_size=fsdp_min_size
+    )
+    sharding = StateSharding(
+        mesh=mesh,
+        tree=TrainState(
+            step=NamedSharding(mesh, P()),
+            params=params_sh,
+            opt_state=shardings_like(shapes.opt_state, shapes.params, params_sh, mesh),
+            batch_stats=jax.tree.map(
+                lambda _: NamedSharding(mesh, P()), shapes.batch_stats
+            ),
+        ),
+    )
+    state = jax.jit(build, out_shardings=sharding.tree)(rng)
+    return state, sharding

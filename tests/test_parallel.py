@@ -81,6 +81,12 @@ class TestTpSpecs:
         assert qk == P(None, "model")  # trailing Nones trimmed
         ok = next(v for k, v in flat.items() if "o_proj" in k and "kernel" in k)
         assert ok == P("model")
+        # root-level params (no leading path segment) must match too —
+        # embedding + lm_head are ~70% of the params at GPT-2 vocab
+        emb = next(v for k, v in flat.items() if "tok_emb" in k)
+        assert emb == P(None, "model")
+        head = next(v for k, v in flat.items() if "lm_head" in k and "kernel" in k)
+        assert head == P(None, "model")
 
     def test_indivisible_tp_raises(self):
         mesh = make_mesh(MeshSpec(data=1, model=8))
