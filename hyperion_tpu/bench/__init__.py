@@ -1,0 +1,2 @@
+"""Benchmark suite: hardware exploration, model baselines, compile tiers,
+scaling experiments (reference C14-C17, C10 — SURVEY §2.1)."""

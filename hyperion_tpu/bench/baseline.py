@@ -1,0 +1,204 @@
+"""Baseline model benchmarks: fwd/bwd/opt decomposition + batch scaling — C17/C15.
+
+Reference: `baseline_performance.ipynb cell 0:70-340` times forward,
+forward+backward, and full train step separately (bwd = total − fwd,
+opt = total − fwd − bwd), records peak memory and samples/s per model
+(ResNet-50, ViT-B/16, CustomTransformer), and sweeps batch sizes until
+OOM. `Phase 1/benchmarking.py` packages the same timers as a library.
+MI250X numbers in BASELINE.md (ResNet-50 bs32: 56.32 ms, 568 samples/s).
+
+JAX-native decomposition: three separately-jitted programs —
+  fwd            logits only
+  fwd+bwd        loss + grads
+  fwd+bwd+opt    full optimizer step
+Each timed with fenced warm iterations. XLA fuses each program globally,
+so "bwd time" = t(fwd+bwd) − t(fwd) measures the *marginal* cost exactly
+as the reference's subtraction did.
+
+CLI: `python -m hyperion_tpu.bench.baseline [--models ...] [--batch-sizes ...]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+from pathlib import Path
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from hyperion_tpu.models.encoder import TransformerEncoder, custom_transformer_config
+from hyperion_tpu.models.resnet import resnet50
+from hyperion_tpu.models.vit import ViT, vit_b16_config
+from hyperion_tpu.utils.memory import peak_bytes_in_use
+from hyperion_tpu.utils.timing import time_fn
+
+
+def _resnet50_spec(batch: int, dtype: str):
+    model = resnet50(num_classes=1000, dtype=dtype)
+    variables = model.init_variables(jax.random.key(0), image_size=224)
+    x = jnp.zeros((batch, 224, 224, 3), jnp.float32)
+    y = jnp.zeros((batch,), jnp.int32)
+
+    def apply(params, batch_stats, x):
+        return model.apply(
+            {"params": params, "batch_stats": batch_stats}, x,
+            train=True, mutable=["batch_stats"],
+        )[0]
+
+    return variables, apply, (x, y)
+
+
+def _vit_spec(batch: int, dtype: str):
+    model = ViT(vit_b16_config(dtype=dtype))
+    variables = {"params": model.init_params(jax.random.key(0))}
+    x = jnp.zeros((batch, 224, 224, 3), jnp.float32)
+    y = jnp.zeros((batch,), jnp.int32)
+
+    def apply(params, batch_stats, x):
+        return model.apply({"params": params}, x, deterministic=True)
+
+    return variables, apply, (x, y)
+
+
+def _custom_transformer_spec(batch: int, dtype: str, seq: int = 16):
+    model = TransformerEncoder(custom_transformer_config(dropout=0.0, dtype=dtype))
+    variables = {"params": model.init_params(jax.random.key(0), seq=seq)}
+    x = jnp.zeros((batch, seq, 512), jnp.float32)
+    y = jnp.zeros((batch, seq, 512), jnp.float32)  # MSE target, as in the reference
+
+    def apply(params, batch_stats, x):
+        return model.apply({"params": params}, x)
+
+    return variables, apply, (x, y)
+
+
+MODEL_SPECS: dict[str, Callable] = {
+    "resnet50": _resnet50_spec,
+    "vit_b16": _vit_spec,
+    "custom_transformer": _custom_transformer_spec,
+}
+
+
+def benchmark_model(
+    name: str, batch: int, dtype: str = "bfloat16",
+    iters: int = 20, warmup: int = 5,
+) -> dict:
+    """One row of the reference's `model_benchmarks.csv`."""
+    variables, apply, (x, y) = MODEL_SPECS[name](batch, dtype)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    def loss_fn(params, batch_stats, x, y):
+        out = apply(params, batch_stats, x)
+        if out.ndim == 2 and y.ndim == 1:  # classification
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out.astype(jnp.float32), y).mean()
+        return jnp.mean((out - y) ** 2)  # reference uses MSE for the encoder
+
+    fwd = jax.jit(lambda p, bs, x, y: loss_fn(p, bs, x, y))
+    fwd_bwd = jax.jit(lambda p, bs, x, y: jax.grad(loss_fn)(p, bs, x, y))
+
+    @jax.jit
+    def full_step(p, bs, opt_state, x, y):
+        grads = jax.grad(loss_fn)(p, bs, x, y)
+        updates, opt_state = tx.update(grads, opt_state, p)
+        return optax.apply_updates(p, updates), opt_state
+
+    t_fwd = time_fn(fwd, params, batch_stats, x, y, warmup=warmup, iters=iters)
+    t_bwd = time_fn(fwd_bwd, params, batch_stats, x, y, warmup=warmup, iters=iters)
+    t_full = time_fn(full_step, params, batch_stats, opt_state, x, y,
+                     warmup=warmup, iters=iters)
+
+    # decomposition by subtraction, clamped at 0 (fusion can make a
+    # superset program faster than the sum of its parts)
+    fwd_ms = t_fwd.mean_ms
+    bwd_ms = max(t_bwd.mean_ms - fwd_ms, 0.0)
+    opt_ms = max(t_full.mean_ms - t_bwd.mean_ms, 0.0)
+
+    peak = peak_bytes_in_use()
+    return {
+        "model": name,
+        "batch_size": batch,
+        "dtype": dtype,
+        "forward_ms": round(fwd_ms, 3),
+        "backward_ms": round(bwd_ms, 3),
+        "optimizer_ms": round(opt_ms, 3),
+        "total_ms": round(t_full.mean_ms, 3),
+        "peak_memory_mb": round(peak / 1e6, 2),
+        "samples_per_s": round(t_full.throughput(batch), 2),
+    }
+
+
+def batch_size_scaling(
+    name: str, batch_sizes=(1, 2, 4, 8, 16, 32, 64), dtype: str = "bfloat16",
+    iters: int = 10,
+) -> list[dict]:
+    """Reference `test_batch_size_scaling`: sweep until OOM, break
+    gracefully (baseline_performance.ipynb cell 0:295-340)."""
+    rows = []
+    for bs in batch_sizes:
+        try:
+            rows.append(benchmark_model(name, bs, dtype, iters=iters, warmup=3))
+        except Exception as e:  # noqa: BLE001 — XLA OOM ends the sweep
+            msg = str(e).splitlines()[0][:120]
+            print(f"[baseline] {name} bs={bs}: stopping sweep ({msg})")
+            break
+    return rows
+
+
+def precision_comparison(
+    name: str, batch: int = 32, dtypes=("float32", "bfloat16"), iters: int = 10
+) -> list[dict]:
+    """C15's `compare_precision_formats`."""
+    return [benchmark_model(name, batch, dt, iters=iters) for dt in dtypes]
+
+
+def _write_csv(path: Path, rows: list[dict]) -> None:
+    if not rows:
+        return
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with path.open("w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(rows[0]))
+        w.writeheader()
+        w.writerows(rows)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--models", nargs="*", default=list(MODEL_SPECS))
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--scaling", action="store_true",
+                   help="also run the batch-size scaling sweep")
+    p.add_argument("--batch-sizes", type=int, nargs="*",
+                   default=[1, 2, 4, 8, 16, 32, 64])
+    p.add_argument("--out", default="results/benchmarks/baseline")
+    args = p.parse_args(argv)
+
+    out = Path(args.out)
+    rows = []
+    for name in args.models:
+        r = benchmark_model(name, args.batch_size, args.dtype, iters=args.iters)
+        rows.append(r)
+        print(f"[baseline] {json.dumps(r)}")
+    _write_csv(out / "model_benchmarks.csv", rows)
+
+    if args.scaling:
+        for name in args.models:
+            sweep = batch_size_scaling(name, args.batch_sizes, args.dtype)
+            _write_csv(out / f"{name}_batch_scaling.csv", sweep)
+            for r in sweep:
+                print(f"[baseline] scaling {json.dumps(r)}")
+    print(f"[baseline] results in {out}/")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""Hardware exploration: MXU TFLOPS sweep + HBM bandwidth — C16.
+
+Reference: `Phase 1/01_hardware_exploration.ipynb cell 1` — device
+enumeration, matmul TFLOPS at 1024–8192^2 for fp32/fp16/bf16, and a
+bandwidth sweep (z = x + y over 10–500M elements, counting 12 bytes per
+element: 2 reads + 1 write of fp32). MI250X results: 121.07 TFLOPS bf16
+@8192, 1248–1269 GB/s sustained (BASELINE.md).
+
+Better-than-reference methodology (SURVEY §6 caveats): the reference
+timed a *single* un-warmed matmul per (size, dtype), including
+allocation; here every point is warmed (absorbing compilation) and the
+median of several fenced iterations. Columns stay comparable.
+
+CLI: `python -m hyperion_tpu.bench.hw_explore [--sizes ...] [--out dir]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+from hyperion_tpu.utils.memory import device_memory_stats
+from hyperion_tpu.utils.timing import time_fn
+
+MATMUL_SIZES = (1024, 2048, 4096, 8192)
+# fp16 included for column parity with the reference sweep; on TPU the
+# MXU's native reduced precision is bf16 and fp16 routes through it.
+MATMUL_DTYPES = ("float32", "bfloat16", "float16")
+BANDWIDTH_ELEMS = (10_000_000, 50_000_000, 100_000_000, 250_000_000, 500_000_000)
+BYTES_PER_ELEM = 12  # 2 fp32 reads + 1 write — the reference's accounting
+
+
+def device_report() -> dict:
+    ds = jax.devices()
+    d = ds[0]
+    stats = device_memory_stats(d)
+    return {
+        "backend": jax.default_backend(),
+        "device_count": len(ds),
+        "device_kind": getattr(d, "device_kind", "unknown"),
+        "platform": d.platform,
+        "hbm_limit_bytes": stats.get("bytes_limit", 0),
+    }
+
+
+def matmul_tflops(
+    sizes=MATMUL_SIZES, dtypes=MATMUL_DTYPES, iters: int = 10
+) -> list[dict]:
+    rows = []
+    for size in sizes:
+        for dtype in dtypes:
+            dt = jnp.dtype(dtype)
+            k0, k1 = jax.random.split(jax.random.key(size))
+            a = jax.random.normal(k0, (size, size), dt)
+            b = jax.random.normal(k1, (size, size), dt)
+            mm = jax.jit(lambda a, b: a @ b)
+            t = time_fn(mm, a, b, warmup=3, iters=iters)
+            tflops = (2 * size**3 / (t.median_ms / 1e3)) / 1e12
+            rows.append({
+                "size": size, "dtype": dtype,
+                "time_ms": round(t.median_ms, 4),
+                "tflops": round(tflops, 2),
+            })
+    return rows
+
+
+def memory_bandwidth(
+    elem_counts=BANDWIDTH_ELEMS, iters: int = 10
+) -> list[dict]:
+    rows = []
+    add = jax.jit(lambda x, y: x + y)
+    for n in elem_counts:
+        k0, k1 = jax.random.split(jax.random.key(n))
+        x = jax.random.normal(k0, (n,), jnp.float32)
+        y = jax.random.normal(k1, (n,), jnp.float32)
+        t = time_fn(add, x, y, warmup=3, iters=iters)
+        gbps = (n * BYTES_PER_ELEM / (t.median_ms / 1e3)) / 1e9
+        rows.append({
+            "elements": n, "time_ms": round(t.median_ms, 4),
+            "gb_per_s": round(gbps, 2),
+        })
+        del x, y
+    return rows
+
+
+def _write_csv(path: Path, rows: list[dict]) -> None:
+    if not rows:
+        return
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with path.open("w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(rows[0]))
+        w.writeheader()
+        w.writerows(rows)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--sizes", type=int, nargs="*", default=list(MATMUL_SIZES))
+    p.add_argument("--dtypes", nargs="*", default=list(MATMUL_DTYPES))
+    p.add_argument("--bandwidth-elems", type=int, nargs="*",
+                   default=list(BANDWIDTH_ELEMS))
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--out", default="results/benchmarks/hardware")
+    p.add_argument("--skip-bandwidth", action="store_true")
+    args = p.parse_args(argv)
+
+    info = device_report()
+    print(f"[hw_explore] {json.dumps(info)}")
+
+    rows = matmul_tflops(args.sizes, args.dtypes, args.iters)
+    for r in rows:
+        print(f"[hw_explore] matmul {r['size']}^2 {r['dtype']:>9}: "
+              f"{r['tflops']:8.2f} TFLOPS ({r['time_ms']:.3f} ms)")
+    out = Path(args.out)
+    _write_csv(out / "precision_results.csv", rows)
+
+    if not args.skip_bandwidth:
+        bw = memory_bandwidth(args.bandwidth_elems, args.iters)
+        for r in bw:
+            print(f"[hw_explore] bandwidth {r['elements']:>11,} elems: "
+                  f"{r['gb_per_s']:8.2f} GB/s")
+        _write_csv(out / "bandwidth_results.csv", bw)
+
+    (out / "device_info.json").write_text(json.dumps(info, indent=2))
+    print(f"[hw_explore] results in {out}/")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Scaling experiment driver — C10 (`run_scaling_experiment`).
+
+Reference: `distributed_utils.py:780-831` shells out to
+`torchrun --nproc_per_node=N run_distributed.py` per GPU count, then
+runs the scaling report. The TPU shape: one process drives any number of
+chips, so "N devices" is a *mesh size*, not a process count — each run
+is a subprocess of the CLI with `--devices N` (subprocess, not in-proc,
+so every run gets a fresh XLA client and clean HBM, and one failed count
+doesn't kill the sweep, matching the reference's CalledProcessError
+tolerance at :826-827).
+
+On hosts with a single real chip the sweep runs on the simulated CPU
+backend (`--xla_force_host_platform_device_count`) — the collectives and
+sharding are real, the absolute times are not; the report is labeled
+accordingly.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+import jax
+
+from hyperion_tpu.metrics.scaling_report import create_scaling_report
+
+
+def _default_counts(limit: int) -> list[int]:
+    counts = [n for n in (1, 2, 4, 8) if n <= limit]
+    return counts or [1]
+
+
+def run_scaling_experiment(
+    device_counts: list[int] | None = None,
+    model: str = "language_ddp",
+    epochs: int = 3,
+    base_dir: str = "data",
+    steps_per_epoch: int = 20,
+    simulate_on_cpu: bool | None = None,
+) -> list[dict]:
+    """Run `model` at each device count in a fresh subprocess; report."""
+    n_real = len(jax.devices())
+    if simulate_on_cpu is None:
+        simulate_on_cpu = n_real < 2  # single chip: simulate the mesh on CPU
+    limit = 8 if simulate_on_cpu else n_real
+    device_counts = device_counts or _default_counts(limit)
+
+    for n in device_counts:
+        cmd = [
+            sys.executable, "-m", "hyperion_tpu.cli.main",
+            "--model", model, "--epochs", str(epochs),
+            "--base_dir", base_dir, "--devices", str(n),
+            "--steps-per-epoch", str(steps_per_epoch),
+        ]
+        env = dict(os.environ)
+        if simulate_on_cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PALLAS_AXON_POOL_IPS"] = ""  # detach any axon TPU tunnel
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={max(device_counts)}"
+            )
+        label = "simulated-cpu" if simulate_on_cpu else jax.default_backend()
+        print(f"[scaling] {n} device(s) ({label}): {' '.join(cmd[2:])}")
+        try:
+            subprocess.run(cmd, check=True, env=env)
+        except subprocess.CalledProcessError as e:
+            # one failed count must not kill the sweep (reference :826-827)
+            print(f"[scaling] run with {n} device(s) failed: {e}")
+        time.sleep(2)  # settle, as the reference did (:823)
+
+    return create_scaling_report(f"{base_dir}/distributed")

@@ -4,8 +4,11 @@ from hyperion_tpu.checkpoint.io import (
     export_gathered,
     latest_step,
     load_gathered,
+    prune,
     restore,
     save,
 )
 
-__all__ = ["export_gathered", "latest_step", "load_gathered", "restore", "save"]
+__all__ = [
+    "export_gathered", "latest_step", "load_gathered", "prune", "restore", "save",
+]

@@ -47,6 +47,24 @@ def save(root: str | Path, state: TrainState, force: bool = False) -> Path:
     return path
 
 
+def prune(root: str | Path, keep: int = 2) -> None:
+    """Delete all but the newest `keep` step directories — an epoch of a
+    7B full fine-tune writes tens of GB of params + Adam state, and
+    restore only ever reads the latest step."""
+    root = Path(root)
+    if not root.is_dir():
+        return
+    steps = sorted(
+        int(m.group(1))
+        for p in root.iterdir()
+        if (m := _STEP_DIR.match(p.name))
+    )
+    for step in steps[:-keep] if keep else steps:
+        import shutil
+
+        shutil.rmtree(_step_path(root, step), ignore_errors=True)
+
+
 def latest_step(root: str | Path) -> int | None:
     root = Path(root)
     if not root.is_dir():

@@ -1,0 +1,1 @@
+"""Command-line entry points (reference C11 — `run_distributed.py`)."""

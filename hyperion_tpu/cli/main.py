@@ -1,0 +1,146 @@
+"""CLI launcher — C11 (`run_distributed.py`), same surface, TPU-native.
+
+Reference CLI (`02_development/run_distributed.py:38-67`):
+  --model {language_ddp,cifar,language_fsdp,llama,all,scaling}
+  --epochs --base_dir --hf_token --model_id --lora --batch_size
+  --progress_every --scaling_gpus
+launched under torchrun per GPU process. Here there is no torchrun:
+one process per host drives every local chip through the mesh; multi-host
+runs bootstrap via `hyperion_tpu.runtime.dist.setup()` env vars
+(JAX_COORDINATOR_ADDRESS / RANK-style compatibility, dist.py).
+
+Differences owned: --hf_token is gone (zero-egress; local checkpoints
+only), --progress_every is replaced by per-epoch logging plus
+--steps-per-epoch, and mesh/precision knobs are exposed because the
+framework actually has them (reference hardcoded those — SURVEY §5.6).
+
+Every run ends with `create_scaling_report` on the primary process, as
+the reference's launcher did (run_distributed.py:148-149).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from hyperion_tpu.config import Config
+from hyperion_tpu.metrics.scaling_report import create_scaling_report
+from hyperion_tpu.runtime import dist
+
+MODELS = ("language_ddp", "cifar", "language_fsdp", "llama", "all", "scaling")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hyperion_tpu", description=__doc__.splitlines()[0]
+    )
+    p.add_argument("--model", choices=MODELS, default="language_ddp")
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--base_dir", default="data")
+    p.add_argument("--batch_size", type=int, default=None,
+                   help="global batch (defaults per job: LM 32, CIFAR 64, llama 8)")
+    p.add_argument("--lora", action="store_true",
+                   help="llama: LoRA adapters instead of FSDP full fine-tune")
+    p.add_argument("--llama_size", choices=["tiny", "7b"], default="7b")
+    p.add_argument("--steps-per-epoch", type=int, default=0,
+                   help="cap steps per epoch (0 = full pass)")
+    p.add_argument("--precision", choices=["fp32", "bf16", "bf16_full"],
+                   default="bf16")
+    p.add_argument("--mesh", default=None,
+                   help="axis sizes data,fsdp,model,seq (e.g. 2,4,1,1); "
+                        "default: all-data, or all-fsdp for *_fsdp jobs")
+    p.add_argument("--devices", type=int, default=0,
+                   help="restrict to first N devices (scaling runs)")
+    p.add_argument("--scaling_devices", type=int, nargs="*", default=None,
+                   help="device counts for --model scaling (default 1,2,4,8 clipped)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--grad_accum", type=int, default=1)
+    p.add_argument("--remat", choices=["none", "full"], default="none")
+    return p
+
+
+_JOB_DEFAULTS = {
+    # reference hardcoded hyperparameters per trainer (SURVEY §5.6):
+    # bs 32 / lr 2e-4 LM-DDP; bs 64 / lr 1e-3 CIFAR; lr 1e-4 LM-FSDP;
+    # bs 1 / lr 1e-5 wd 0.01 llama (bs 8 here — a v5e fits it)
+    "language_ddp": dict(batch_size=32, learning_rate=2e-4),
+    "language_fsdp": dict(batch_size=32, learning_rate=1e-4),
+    "cifar": dict(batch_size=64, learning_rate=1e-3),
+    "llama": dict(batch_size=8, learning_rate=1e-5, weight_decay=0.01),
+}
+
+
+def make_config(args, job: str) -> Config:
+    cfg = Config()
+    d = _JOB_DEFAULTS[job]
+    cfg.train.epochs = args.epochs
+    cfg.train.base_dir = args.base_dir
+    cfg.train.batch_size = args.batch_size or d["batch_size"]
+    cfg.train.learning_rate = args.lr or d["learning_rate"]
+    cfg.train.weight_decay = d.get("weight_decay", 0.0)
+    cfg.train.steps_per_epoch = args.steps_per_epoch
+    cfg.train.seed = args.seed
+    cfg.train.lora = args.lora
+    cfg.train.model = "llama_tiny" if args.llama_size == "tiny" else "llama_7b"
+    cfg.optimization.precision = args.precision
+    cfg.optimization.grad_accum_steps = args.grad_accum
+    cfg.optimization.remat = args.remat
+    if job in ("language_fsdp", "llama"):
+        cfg.optimization.grad_clip_norm = 1.0  # reference clip 1.0 (:351,522)
+    cfg.distributed.max_devices = args.devices
+    if args.mesh:
+        data, fsdp, model, seq = (int(x) for x in args.mesh.split(","))
+        cfg.distributed.data = data
+        cfg.distributed.fsdp = fsdp
+        cfg.distributed.model = model
+        cfg.distributed.seq = seq
+    elif job in ("language_fsdp",) or (job == "llama" and not args.lora):
+        cfg.distributed.data = 1
+        cfg.distributed.fsdp = -1  # whole mesh on the fsdp axis
+    return cfg
+
+
+def run_job(args, job: str):
+    from hyperion_tpu.train import trainer
+
+    if job == "language_ddp":
+        return trainer.train_language_model(make_config(args, job), "language_ddp")
+    if job == "language_fsdp":
+        return trainer.train_language_model(make_config(args, job), "language_fsdp")
+    if job == "cifar":
+        return trainer.train_cifar_model(make_config(args, job), "cifar_ddp")
+    if job == "llama":
+        return trainer.train_llama(make_config(args, job), "llama")
+    raise ValueError(job)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    dist.setup()
+
+    if args.model == "scaling":
+        from hyperion_tpu.bench.scaling import run_scaling_experiment
+
+        run_scaling_experiment(
+            device_counts=args.scaling_devices,
+            epochs=args.epochs,
+            base_dir=args.base_dir,
+            steps_per_epoch=args.steps_per_epoch or 20,
+        )
+    else:
+        jobs = (
+            ["language_ddp", "cifar", "language_fsdp", "llama"]
+            if args.model == "all" else [args.model]
+        )
+        for job in jobs:  # reference 'all' runs the four jobs sequentially
+            run_job(args, job)
+
+    if dist.is_primary():
+        create_scaling_report(f"{args.base_dir}/distributed")
+    dist.cleanup()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,7 @@ class DistributedConfig:
     fsdp: int = 1
     model: int = 1
     seq: int = 1
+    max_devices: int = 0  # 0 = all; >0 restricts the mesh to the first N
     coordinator_address: str | None = None
     num_processes: int | None = None
     process_id: int | None = None

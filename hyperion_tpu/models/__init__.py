@@ -19,3 +19,22 @@ from hyperion_tpu.models.transformer_lm import (  # noqa: F401
     simple_lm_config,
 )
 from hyperion_tpu.models.resnet import ResNet, resnet18, resnet50  # noqa: F401
+from hyperion_tpu.models.encoder import (  # noqa: F401
+    TransformerEncoder,
+    custom_transformer_config,
+)
+from hyperion_tpu.models.vit import ViT, ViTConfig, vit_b16_config  # noqa: F401
+from hyperion_tpu.models.llama import (  # noqa: F401
+    Llama,
+    LlamaConfig,
+    llama2_7b_config,
+    llama_tiny_config,
+    load_hf_checkpoint,
+)
+from hyperion_tpu.models.lora import (  # noqa: F401
+    LoraConfig,
+    apply_lora,
+    init_lora_params,
+    merge_lora,
+    trainable_fraction,
+)

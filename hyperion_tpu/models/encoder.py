@@ -1,0 +1,52 @@
+"""Continuous-input Transformer encoder — the benchmark "CustomTransformer".
+
+Reference: `baseline_performance.ipynb cell 0:56-67` builds a
+`nn.TransformerEncoder` (d_model 512, 8 heads, 6 layers, torch-default
+ff 2048) that takes a raw `[B, T, d_model]` float tensor — no embedding —
+and is benchmarked at batch 32, seq 16 with MSE loss (BASELINE.md:
+12.52 ms, 2555.9 samples/s on MI250X).
+
+Reuses the LM's pre-LN `Block` with `causal=False`; the reference's
+torch-default post-LN is a training-stability liability in bf16, and the
+benchmark only cares about the op mix (attention + MLP at these dims).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from hyperion_tpu.models.transformer_lm import Block, TransformerLMConfig
+
+
+def custom_transformer_config(**kw) -> TransformerLMConfig:
+    base = dict(
+        d_model=512, n_heads=8, n_layers=6, ff_dim=2048,
+        activation="relu", causal=False, dropout=0.1,
+    )
+    base.update(kw)
+    return TransformerLMConfig(**base)
+
+
+class TransformerEncoder(nn.Module):
+    """Stack of bidirectional blocks over a continuous [B, T, D] input."""
+
+    cfg: TransformerLMConfig
+
+    @nn.compact
+    def __call__(self, x, padding_mask=None, deterministic: bool = True):
+        c = self.cfg
+        if x.shape[-1] != c.d_model:
+            raise ValueError(f"input dim {x.shape[-1]} != d_model {c.d_model}")
+        x = x.astype(c.compute_dtype)
+        block = Block
+        if c.remat:
+            block = nn.remat(Block, static_argnums=(3,))
+        for i in range(c.n_layers):
+            x = block(c, name=f"block_{i}")(x, padding_mask, deterministic)
+        return x.astype(jnp.float32)
+
+    def init_params(self, rng: jax.Array, batch: int = 2, seq: int = 16):
+        x = jnp.zeros((batch, seq, self.cfg.d_model), jnp.float32)
+        return self.init(rng, x)["params"]

@@ -44,6 +44,7 @@ class TransformerLMConfig:
     dropout: float = 0.1
     activation: str = "relu"       # relu | gelu
     attention_impl: str = "xla"    # xla | pallas
+    causal: bool = True            # False → bidirectional encoder blocks
     remat: bool = False            # jax.checkpoint each block
     dtype: str = "float32"         # compute dtype; params stay fp32
 
@@ -84,7 +85,7 @@ class MHA(nn.Module):
         k = dense(name="k_proj")(x)
         v = dense(name="v_proj")(x)
         out = dot_product_attention(
-            q, k, v, causal=True, padding_mask=padding_mask, impl=c.attention_impl
+            q, k, v, causal=c.causal, padding_mask=padding_mask, impl=c.attention_impl
         )
         return nn.DenseGeneral(
             features=c.d_model,
@@ -146,7 +147,7 @@ class TransformerLM(nn.Module):
 
         block = Block
         if c.remat:
-            block = nn.remat(Block, static_argnums=(2,))
+            block = nn.remat(Block, static_argnums=(3,))
         for i in range(c.n_layers):
             x = block(c, name=f"block_{i}")(x, padding_mask, deterministic)
         x = nn.LayerNorm(dtype=c.compute_dtype, name="ln_f")(x)

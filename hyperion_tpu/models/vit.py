@@ -1,0 +1,102 @@
+"""Vision Transformer (ViT-B/16) — the third baseline-benchmark model.
+
+Reference: `baseline_performance.ipynb cell 0:28-54` uses torchvision
+`vit_b_16` (224x224 input, 16x16 patches, d 768, 12 layers, 12 heads,
+mlp 3072, 1000 classes; 5.44 ms / 5883 samples/s at batch 32 on MI250X —
+BASELINE.md), with a small-CNN fallback when ViT is unavailable.
+
+TPU-first: patchify is a strided conv in NHWC (one big MXU matmul after
+im2col — XLA does this transform), and the encoder reuses the shared
+pre-LN `Block` (torchvision's ViT encoder is also pre-LN) so the
+attention op — and later its Pallas kernel — is one implementation
+across LM/encoder/ViT.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from hyperion_tpu.models.transformer_lm import Block, TransformerLMConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    ff_dim: int = 3072
+    num_classes: int = 1000
+    dropout: float = 0.0
+    attention_impl: str = "xla"
+    remat: bool = False
+    dtype: str = "float32"
+
+    @property
+    def n_patches(self) -> int:
+        assert self.image_size % self.patch_size == 0
+        return (self.image_size // self.patch_size) ** 2
+
+    def block_cfg(self) -> TransformerLMConfig:
+        return TransformerLMConfig(
+            d_model=self.d_model, n_heads=self.n_heads, n_layers=self.n_layers,
+            ff_dim=self.ff_dim, dropout=self.dropout, activation="gelu",
+            causal=False, attention_impl=self.attention_impl,
+            remat=self.remat, dtype=self.dtype,
+        )
+
+
+def vit_b16_config(**kw) -> ViTConfig:
+    return ViTConfig(**kw)
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, deterministic: bool = True):
+        """images: [B, H, W, 3] NHWC → logits fp32 [B, num_classes]."""
+        c = self.cfg
+        bc = c.block_cfg()
+        dt = bc.compute_dtype
+        B = images.shape[0]
+        x = nn.Conv(
+            c.d_model,
+            (c.patch_size, c.patch_size),
+            strides=(c.patch_size, c.patch_size),
+            padding="VALID",
+            dtype=dt,
+            name="patch_embed",
+        )(images.astype(dt))
+        x = x.reshape(B, -1, c.d_model)  # [B, n_patches, D]
+
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, c.d_model), jnp.float32
+        )
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, c.d_model)).astype(dt), x], 1)
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(0.02),
+            (1, c.n_patches + 1, c.d_model),
+            jnp.float32,
+        )
+        x = x + pos.astype(dt)
+        x = nn.Dropout(c.dropout, deterministic=deterministic)(x)
+
+        block = Block
+        if c.remat:
+            block = nn.remat(Block, static_argnums=(3,))
+        for i in range(c.n_layers):
+            x = block(bc, name=f"block_{i}")(x, None, deterministic)
+        x = nn.LayerNorm(dtype=dt, name="ln_f")(x)
+        logits = nn.Dense(c.num_classes, dtype=dt, name="head")(x[:, 0])
+        return logits.astype(jnp.float32)
+
+    def init_params(self, rng: jax.Array, batch: int = 1):
+        imgs = jnp.zeros((batch, self.cfg.image_size, self.cfg.image_size, 3))
+        return self.init(rng, imgs)["params"]

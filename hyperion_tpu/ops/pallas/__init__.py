@@ -1,0 +1,5 @@
+"""In-tree Pallas TPU kernels — the Triton/Inductor analogue (SURVEY §2.3)."""
+
+from hyperion_tpu.ops.pallas.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,201 @@
+"""Flash attention — the in-tree Pallas kernel for the framework's hot op.
+
+Role in the stack (SURVEY §2.3): the reference's "tuned kernel" tier is
+TorchInductor/Triton via `torch.compile(mode="max-autotune")`
+(`compilation_optimization.py:96-103`); ours is this kernel, selected
+with `attention_impl="pallas"` and benchmarked against the plain-XLA
+attention by `compile_bench`.
+
+Design (classic flash attention, TPU-shaped):
+  * grid (batch, heads, q-blocks); per program: one q tile in VMEM,
+    online-softmax sweep over kv tiles with a `fori_loop`, running
+    (m, l, acc) carried in fp32 registers/VMEM.
+  * logits and softmax statistics in fp32 (`preferred_element_type`),
+    p·v accumulation in fp32, cast to the input dtype at the end.
+  * causal programs stop their kv sweep at the diagonal tile — the
+    standard ~2x FLOP saving — and the in-tile diagonal is masked with
+    broadcasted iotas.
+  * padding masks ([B, T], 1 = real) ride in as a (1, T) block per
+    batch row.
+
+Backward: `jax.custom_vjp` whose bwd recomputes attention with the plain
+XLA formulation and differentiates that — numerically identical
+gradients, flash-speed forward. A hand-written flash backward kernel is
+the known next step (tracked in compile_bench as "pallas-fwd" tier).
+
+On non-TPU backends the kernel runs in interpret mode so the full test
+suite exercises it on the simulated CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from hyperion_tpu.ops.attention import NEG_INF, _xla_attention, causal_mask
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_KV = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(
+    *refs,
+    causal: bool, sm_scale: float, block_q: int, block_kv: int, kv_len: int,
+):
+    # q_ref: (1, 1, block_q, D); k/v_ref: (1, 1, kv_len, D);
+    # pad_ref: (1, kv_len) int8, present only when a padding mask is
+    # passed (pallas hands refs positionally: inputs then outputs).
+    if len(refs) == 5:
+        q_ref, k_ref, v_ref, pad_ref, o_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref = refs
+        pad_ref = None
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (block_q, D)
+
+    n_kv_blocks = pl.cdiv(kv_len, block_kv)
+    if causal:
+        # sweep only to the tile containing this q block's last row
+        n_kv_blocks = jnp.minimum(
+            n_kv_blocks, pl.cdiv((qi + 1) * block_q, block_kv)
+        )
+
+    def body(kv_i, carry):
+        m_prev, l_prev, acc = carry
+        kv_start = kv_i * block_kv
+        k = k_ref[0, 0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_kv)
+
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0
+        )
+        kv_pos = kv_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1
+        )
+        mask = jnp.ones_like(s, jnp.bool_)
+        if causal:
+            mask = kv_pos <= q_pos
+        if pad_ref is not None:
+            pad = pad_ref[0, pl.ds(kv_start, block_kv)] > 0  # (block_kv,)
+            mask = jnp.logical_and(mask, pad[None, :])
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc
+
+    D = q_ref.shape[-1]
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_kv_blocks, body, (m0, l0, acc0))
+    o = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv):
+    B, Tq, H, D = q.shape
+    Tkv = k.shape[1]
+    block_q = min(block_q, Tq)
+    block_kv = min(block_kv, Tkv)
+    if Tq % block_q or Tkv % block_kv:
+        raise ValueError(
+            f"seq lengths (q={Tq}, kv={Tkv}) must divide block sizes "
+            f"({block_q}, {block_kv})"
+        )
+    # [B, T, H, D] → [B, H, T, D]: heads become a grid axis
+    qT = q.transpose(0, 2, 1, 3)
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+
+    grid = (B, H, Tq // block_q)
+    qspec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0))
+    kvspec = pl.BlockSpec((1, 1, Tkv, D), lambda b, h, i: (b, h, 0, 0))
+    in_specs = [qspec, kvspec, kvspec]
+    args = [qT, kT, vT]
+    if padding_mask is not None:
+        in_specs.append(pl.BlockSpec((1, Tkv), lambda b, h, i: (b, 0)))
+        args.append(padding_mask.astype(jnp.int8))
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        causal=causal,
+        sm_scale=1.0 / (D ** 0.5),
+        block_q=block_q,
+        block_kv=block_kv,
+        kv_len=Tkv,
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(qT.shape, q.dtype),
+        interpret=_interpret(),
+    )(*args)
+    return out.transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _flash(causal, block_q, block_kv, q, k, v, padding_mask):
+    return _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv)
+
+
+def flash_attention(
+    q, k, v, *, causal: bool = False, padding_mask=None,
+    block_q: int = DEFAULT_BLOCK_Q, block_kv: int = DEFAULT_BLOCK_KV,
+):
+    """Drop-in for `ops.attention.dot_product_attention` over
+    [B, T, H, D] tensors. padding_mask: [B, Tkv], 1 = real token."""
+    return _flash(causal, block_q, block_kv, q, k, v, padding_mask)
+
+
+def _xla_reference(q, k, v, padding_mask, causal):
+    mask = None
+    if causal:
+        mask = causal_mask(q.shape[1], k.shape[1])[None, None]
+    if padding_mask is not None:
+        pad = padding_mask[:, None, None, :].astype(jnp.bool_)
+        mask = pad if mask is None else jnp.logical_and(mask, pad)
+    return _xla_attention(q, k, v, mask)
+
+
+def _fwd(causal, block_q, block_kv, q, k, v, padding_mask):
+    out = _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv)
+    return out, (q, k, v, padding_mask)
+
+
+def _bwd(causal, block_q, block_kv, residuals, g):
+    q, k, v, padding_mask = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: _xla_reference(q, k, v, padding_mask, causal), q, k, v
+    )
+    dq, dk, dv = vjp(g)
+    # integer mask cotangent is float0 (None when no mask was passed)
+    dmask = (
+        None if padding_mask is None
+        else np.zeros(padding_mask.shape, jax.dtypes.float0)
+    )
+    return dq, dk, dv, dmask
+
+
+_flash.defvjp(_fwd, _bwd)

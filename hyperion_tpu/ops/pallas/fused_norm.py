@@ -1,0 +1,117 @@
+"""Fused residual-add + LayerNorm Pallas kernel.
+
+The second of the two "tuned tier" kernels (SURVEY §7.1: "fused
+attention, fused LN/residual"). XLA usually fuses LN chains well on its
+own — this kernel exists to (a) guarantee the fusion (one HBM round-trip
+for `residual + x` → normalize → scale/shift) and (b) be the measurable
+Pallas-vs-XLA data point `compile_bench` reports alongside attention.
+
+Statistics are computed in fp32 regardless of input dtype (bf16 mean/var
+is exactly where LN goes wrong); the normalized output is cast back.
+
+Backward: custom_vjp recomputing via the plain-jnp formulation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_ROWS = 256
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _kernel(x_ref, res_ref, w_ref, b_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    if res_ref is not None:
+        x = x + res_ref[:].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    y = y * w_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    o_ref[:] = y.astype(o_ref.dtype)
+
+
+def _kernel_no_res(x_ref, w_ref, b_ref, o_ref, *, eps: float):
+    _kernel(x_ref, None, w_ref, b_ref, o_ref, eps=eps)
+
+
+def _forward(x, residual, weight, bias, eps, block_rows):
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    x2 = x.reshape(-1, d)
+    rows = x2.shape[0]
+    block = min(block_rows, rows)
+    if rows % block:
+        block = rows  # odd row counts: single block (still one fused pass)
+
+    row_spec = pl.BlockSpec((block, d), lambda i: (i, 0))
+    wb_spec = pl.BlockSpec((d,), lambda i: (0,))
+    if residual is not None:
+        args = [x2, residual.reshape(-1, d), weight, bias]
+        in_specs = [row_spec, row_spec, wb_spec, wb_spec]
+        kernel = functools.partial(_kernel, eps=eps)
+    else:
+        args = [x2, weight, bias]
+        in_specs = [row_spec, wb_spec, wb_spec]
+        kernel = functools.partial(_kernel_no_res, eps=eps)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows // block,),
+        in_specs=in_specs,
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x.dtype),
+        interpret=_interpret(),
+    )(*args)
+    return out.reshape(orig_shape)
+
+
+def _reference(x, residual, weight, bias, eps):
+    h = x.astype(jnp.float32)
+    if residual is not None:
+        h = h + residual.astype(jnp.float32)
+    mean = jnp.mean(h, -1, keepdims=True)
+    var = jnp.mean(jnp.square(h - mean), -1, keepdims=True)
+    y = (h - mean) * jax.lax.rsqrt(var + eps) * weight + bias
+    return y.astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _fused(eps, block_rows, x, residual, weight, bias):
+    return _forward(x, residual, weight, bias, eps, block_rows)
+
+
+def fused_layernorm(
+    x, weight, bias, *, residual=None, eps: float = 1e-5,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+):
+    """`LayerNorm(x + residual) * weight + bias` in one HBM pass.
+    x: [..., d]; weight/bias: [d]; residual: same shape as x or None."""
+    return _fused(eps, block_rows, x, residual, weight, bias)
+
+
+def _fwd(eps, block_rows, x, residual, weight, bias):
+    out = _forward(x, residual, weight, bias, eps, block_rows)
+    return out, (x, residual, weight, bias)
+
+
+def _bwd(eps, block_rows, res, g):
+    x, residual, weight, bias = res
+    if residual is None:
+        _, vjp = jax.vjp(lambda x, w, b: _reference(x, None, w, b, eps),
+                         x, weight, bias)
+        dx, dw, db = vjp(g)
+        return dx, None, dw, db
+    _, vjp = jax.vjp(lambda x, r, w, b: _reference(x, r, w, b, eps),
+                     x, residual, weight, bias)
+    return vjp(g)
+
+
+_fused.defvjp(_fwd, _bwd)

@@ -12,6 +12,7 @@ from hyperion_tpu.train.trainer import (
     TrainResult,
     train_cifar_model,
     train_language_model,
+    train_llama,
 )
 
 __all__ = [
@@ -26,4 +27,5 @@ __all__ = [
     "next_token_loss",
     "train_cifar_model",
     "train_language_model",
+    "train_llama",
 ]

@@ -46,12 +46,17 @@ def make_train_step(
     grad_accum: int = 1,
     donate: bool = True,
     dropout: bool = False,
+    sum_metrics: tuple[str, ...] = ("correct", "total"),
 ):
     """Compile the train step against a fixed state layout.
 
     Signature of the returned fn: `(state, batch, rng) -> (state, metrics)`.
     `rng` is folded with the step counter so dropout differs per step
     without threading a key chain through the host loop.
+
+    `sum_metrics` declares which metric keys are counts (summed across
+    microbatches under grad accumulation); everything else is averaged.
+    Callers introducing new count-style metrics must list them here.
     """
     replicated = NamedSharding(sharding.mesh, P())
 
@@ -87,12 +92,11 @@ def make_train_step(
             (jnp.arange(grad_accum), micro),
         )
         grads = jax.tree.map(lambda g: g / grad_accum, grads)
-        # sum-metrics (correct/total) sum over micros; mean-metrics average
         metrics = jax.tree.map(
             lambda m: m.sum(0) if m.ndim else m, metrics
         )
         metrics = {
-            k: (v / grad_accum if k not in ("correct", "total") else v)
+            k: (v if k in sum_metrics else v / grad_accum)
             for k, v in metrics.items()
         }
         return grads, metrics, new_bs

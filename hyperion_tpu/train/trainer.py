@@ -34,6 +34,13 @@ from hyperion_tpu.data.sharding import ShardedBatches
 from hyperion_tpu.data.text import load_wikitext2
 from hyperion_tpu.data.vision import load_cifar10
 from hyperion_tpu.metrics.csv_logger import CsvLogger
+from hyperion_tpu.models.llama import Llama, llama2_7b_config, llama_tiny_config, load_hf_checkpoint
+from hyperion_tpu.models.lora import (
+    LoraConfig,
+    apply_lora,
+    init_lora_params,
+    trainable_fraction,
+)
 from hyperion_tpu.models.resnet import resnet18
 from hyperion_tpu.models.transformer_lm import TransformerLM, simple_lm_config
 from hyperion_tpu.parallel.partition import TRANSFORMER_TP_RULES
@@ -112,26 +119,41 @@ def _epoch_loop(
             gpus=n_devices, **extra,
         )
         if dist.is_primary():
-            extras = "".join(f" {k}={v:.4f}" for k, v in extra.items())
+            extras = "".join(
+                f" {k}={v:.4f}" if isinstance(v, float) else f" {k}={v}"
+                for k, v in extra.items()
+            )
             print(
                 f"[{job}] epoch {row.epoch}/{cfg.train.epochs} "
                 f"loss={loss:.4f}{extras} ({duration:.2f}s)"
             )
         if ckpt_dir:
             ckpt.save(ckpt_dir, state, force=True)
+            ckpt.prune(ckpt_dir, keep=2)  # full sharded state per epoch adds up
     return state, history
 
 
 def _build_mesh(cfg: Config):
-    return make_mesh(cfg.distributed.mesh_spec())
+    devices = None
+    if cfg.distributed.max_devices:
+        devices = jax.devices()[: cfg.distributed.max_devices]
+    return make_mesh(cfg.distributed.mesh_spec(), devices=devices)
 
 
 def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int):
     """CSV logger + checkpoint-restore/resume bookkeeping shared by every
     trainer. Returns (logger, ckpt_dir, state, resume_epoch)."""
     logger = CsvLogger(job, n_devices, cfg.train.base_dir)
-    ckpt_dir = f"{cfg.train.base_dir}/checkpoints/{job}"
+    # world-size-specific, like the reference's run ids: a 2-device run
+    # must not resume a 1-device run's checkpoint (their shardings and
+    # their scaling-experiment roles differ)
+    ckpt_dir = f"{cfg.train.base_dir}/checkpoints/{job}_{n_devices}dev"
     steps_per_epoch = min(len(batches), cfg.train.steps_per_epoch or len(batches))
+    if steps_per_epoch <= 0:
+        raise ValueError(
+            f"zero steps per epoch: batch_size {cfg.train.batch_size} vs "
+            f"dataset of {batches.n} examples (drop_last semantics)"
+        )
     restored = ckpt.restore(ckpt_dir, state)
     resume_epoch = 0
     if restored is not None:
@@ -265,5 +287,129 @@ def train_cifar_model(cfg: Config, job: str = "cifar_ddp") -> TrainResult:
     )
     ckpt.export_gathered(
         f"{cfg.train.base_dir}/checkpoints/{job}_final.npz", state.params
+    )
+    return TrainResult(job, logger.run, str(logger.path), ckpt_dir, history)
+
+
+def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
+    """Llama-2 fine-tuning — C8 (`train_llama_fsdp`,
+    distributed_utils.py:415-554). Two modes, as in the reference:
+      * `cfg.train.lora` → frozen bf16 base + LoRA adapters (peft+DDP
+        analogue, :463-476): the optimizer is `optax.multi_transform`
+        with AdamW on the adapters and `set_to_zero` on the base, so
+        optimizer state for the 7B base simply never exists — the
+        TPU-native form of "peft shrinks optimizer memory".
+      * else → full fine-tune, FSDP-sharded, bf16 params/compute/reduce
+        (FSDP FULL_SHARD + MixedPrecision(bf16) analogue, :477-500).
+    Weights: local HF checkpoint when present, else random init
+    (SURVEY §7.3 — mechanics/throughput measurable without the 34 GB).
+    """
+    import optax
+
+    dist.setup()
+    mesh = _build_mesh(cfg)
+    n_dev = mesh.devices.size
+
+    llcfg = (
+        llama_tiny_config() if cfg.train.model == "llama_tiny"
+        else llama2_7b_config(max_len=max(cfg.train.seq_len, 128))
+    )
+    model = Llama(llcfg)
+    mode = "lora_bf16" if cfg.train.lora else "fsdp_bf16"
+
+    splits = load_wikitext2(
+        cfg.train.base_dir, splits=("train",), seq_len=cfg.train.seq_len,
+        seed=cfg.train.seed,
+    )
+    train_split = splits["train"]
+    # clamp synthetic GPT-2-vocab ids into the Llama vocab
+    ids = np.minimum(train_split.input_ids, llcfg.vocab_size - 1)
+    batches = ShardedBatches(
+        {"input_ids": ids, "attention_mask": train_split.attention_mask},
+        cfg.train.batch_size, mesh, shuffle=True, seed=cfg.train.seed,
+    )
+
+    lora_cfg = LoraConfig(rank=cfg.train.lora_rank, alpha=cfg.train.lora_alpha)
+    rng = jax.random.key(cfg.train.seed)
+
+    def init_variables(r):
+        base = model.init_params(r, seq=min(cfg.train.seq_len, llcfg.max_len))
+        if cfg.train.lora:
+            return {"params": {
+                "base": base,
+                "lora": init_lora_params(jax.random.fold_in(r, 1), base, lora_cfg),
+            }}
+        return {"params": base}
+
+    adamw = make_optimizer(
+        cfg.train.learning_rate, cfg.train.weight_decay,
+        cfg.optimization.grad_clip_norm,
+    )
+    if cfg.train.lora:
+        optimizer = optax.multi_transform(
+            {"train": adamw, "freeze": optax.set_to_zero()},
+            param_labels={"base": "freeze", "lora": "train"},
+        )
+    else:
+        optimizer = adamw
+
+    policy = "bf16_full" if llcfg.compute_dtype == jnp.bfloat16 else "fp32"
+    state, sharding = create_train_state(
+        init_variables, optimizer, mesh, rng, policy=policy,
+        tp_rules=TRANSFORMER_TP_RULES, fsdp=True,
+    )
+    # Real weights, if present on disk, replace the random init *after*
+    # the jitted init (loading inside the traced fn would bake the 7B
+    # weights into the executable as constants). device_put against the
+    # existing shardings streams each host's shards into place.
+    hf = load_hf_checkpoint(f"{cfg.train.base_dir}/llama2_hf", llcfg)
+    if hf is not None:
+        pol = get_policy(policy)
+        sh_tree = sharding.tree.params["base"] if cfg.train.lora else sharding.tree.params
+        loaded = jax.tree.map(
+            lambda w, s: jax.device_put(w.astype(jnp.dtype(pol.param_dtype)), s),
+            hf, sh_tree,
+        )
+        if cfg.train.lora:
+            state = state.replace(params={**state.params, "base": loaded})
+        else:
+            state = state.replace(params=loaded)
+        if dist.is_primary():
+            print(f"[{job}] loaded HF weights from {cfg.train.base_dir}/llama2_hf")
+    if cfg.train.lora and dist.is_primary():
+        frac = trainable_fraction(state.params["base"], state.params["lora"])
+        print(f"[{job}] mode={mode} trainable params: {100 * frac:.3f}% of base")
+
+    def loss_fn(params, batch_stats, batch, rngs):
+        eff = (
+            apply_lora(params["base"], params["lora"], lora_cfg)
+            if cfg.train.lora else params
+        )
+        logits = model.apply(
+            {"params": eff}, batch["input_ids"],
+            padding_mask=batch["attention_mask"],
+        )
+        loss = next_token_loss(logits, batch["input_ids"], batch["attention_mask"])
+        return loss, ({"loss": loss}, batch_stats)
+
+    train_step = make_train_step(
+        loss_fn, optimizer, sharding,
+        grad_accum=cfg.optimization.grad_accum_steps,
+        donate=cfg.optimization.donate_state,
+    )
+
+    logger, ckpt_dir, state, resume_epoch = _prepare_run(
+        job, cfg, state, batches, n_dev
+    )
+    state, history = _epoch_loop(
+        job=job, cfg=cfg, batches=batches, state=state, train_step=train_step,
+        rng=rng, logger=logger, n_devices=n_dev,
+        extra_cols=lambda _: {"mode": mode},
+        ckpt_dir=ckpt_dir, resume_epoch=resume_epoch,
+    )
+    # save_pretrained analogue: adapters alone for LoRA, else full params
+    export = state.params["lora"] if cfg.train.lora else state.params
+    ckpt.export_gathered(
+        f"{cfg.train.base_dir}/checkpoints/{job}_{mode}_final.npz", export
     )
     return TrainResult(job, logger.run, str(logger.path), ckpt_dir, history)
