@@ -1,0 +1,2 @@
+"""Native (C++) runtime components, built on first use (see build.py):
+recordio (mmap data store) and coord (host rendezvous/barrier/health)."""

@@ -1,0 +1,54 @@
+"""Build-on-first-use for the native (C++) runtime components.
+
+No pybind11 in the image (task environment), so the extensions are plain
+C-ABI shared objects compiled with g++ and loaded via ctypes. Artifacts
+are cached next to the sources in `_build/` keyed by a source hash, so a
+source edit triggers a rebuild and an unchanged tree never recompiles.
+A `Makefile` in this directory builds the same objects for ahead-of-time
+packaging.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import subprocess
+from pathlib import Path
+
+_DIR = Path(__file__).parent
+_BUILD = _DIR / "_build"
+_CXX_FLAGS = ["-O2", "-std=c++17", "-shared", "-fPIC", "-Wall"]
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _source_hash(src: Path) -> str:
+    return hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+
+
+def shared_object(name: str) -> Path:
+    """Compile `<name>.cpp` → cached `.so`; return its path."""
+    src = _DIR / f"{name}.cpp"
+    if not src.exists():
+        raise NativeBuildError(f"no such native source: {src}")
+    out = _BUILD / f"{name}-{_source_hash(src)}.so"
+    if out.exists():
+        return out
+    _BUILD.mkdir(exist_ok=True)
+    cmd = ["g++", *_CXX_FLAGS, "-o", str(out), str(src)]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"g++ failed for {src.name}:\n{proc.stderr[-2000:]}"
+        )
+    # drop stale builds of the same unit
+    for old in _BUILD.glob(f"{name}-*.so"):
+        if old != out:
+            old.unlink(missing_ok=True)
+    return out
+
+
+def load(name: str) -> ctypes.CDLL:
+    return ctypes.CDLL(str(shared_object(name)))

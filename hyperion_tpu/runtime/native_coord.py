@@ -1,0 +1,102 @@
+"""Python face of the native host-coordination layer (native/coord.cpp).
+
+Complements `runtime.dist` (SURVEY §5.3): JAX's coordinator handles
+collective rendezvous; this layer gives trainers the operational pieces
+the reference leaned on torchrun/NCCL-watchdog for — a pre-flight
+handshake with a hard timeout (the `setup(timeout=5min)` analogue), named
+barriers independent of any JAX computation (e.g. around checkpoint IO),
+and fail-fast peer-death detection instead of a hung collective.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+from hyperion_tpu.native import build
+
+DEFAULT_PORT = 29501  # beside the reference's MASTER_PORT 29500
+
+
+class CoordError(RuntimeError):
+    pass
+
+
+def _lib() -> ctypes.CDLL:
+    lib = build.load("coord")
+    lib.hypcoord_serve.restype = ctypes.c_void_p
+    lib.hypcoord_serve.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.hypcoord_connect.restype = ctypes.c_void_p
+    lib.hypcoord_connect.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.hypcoord_barrier.restype = ctypes.c_int
+    lib.hypcoord_barrier.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.hypcoord_alive_count.restype = ctypes.c_int
+    lib.hypcoord_alive_count.argtypes = [ctypes.c_void_p]
+    lib.hypcoord_close.restype = None
+    lib.hypcoord_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class HostCoordinator:
+    """Rank 0 serves, everyone else connects; `barrier()` syncs all
+    hosts or raises with a reason (timeout vs dead peer)."""
+
+    def __init__(
+        self,
+        rank: int,
+        world: int,
+        host: str = "127.0.0.1",
+        port: int = DEFAULT_PORT,
+        timeout_s: float = 300.0,  # reference PG-init timeout (SURVEY C1)
+    ):
+        self.rank = rank
+        self.world = world
+        self._lib = _lib()
+        ms = int(timeout_s * 1000)
+        if rank == 0:
+            self._handle = self._lib.hypcoord_serve(port, world, ms)
+        else:
+            self._handle = self._lib.hypcoord_connect(
+                host.encode(), port, rank, ms
+            )
+        if not self._handle:
+            raise CoordError(
+                f"host rendezvous failed (rank {rank}/{world} @ {host}:{port})"
+            )
+
+    def barrier(self, timeout_s: float = 60.0) -> None:
+        rc = self._lib.hypcoord_barrier(self._handle, int(timeout_s * 1000))
+        if rc == -2:
+            raise CoordError(f"barrier timeout after {timeout_s}s (rank {self.rank})")
+        if rc != 0:
+            raise CoordError(f"barrier failed — peer died (rank {self.rank})")
+
+    def alive_count(self) -> int:
+        """Coordinator's view of live hosts (workers: own liveness only)."""
+        n = self._lib.hypcoord_alive_count(self._handle)
+        return self.world if n < 0 else n
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.hypcoord_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def from_env(timeout_s: float = 300.0) -> HostCoordinator | None:
+    """Build from the same env the reference's setup() read
+    (RANK/WORLD_SIZE/MASTER_ADDR — SURVEY C1); None for single-host."""
+    world = int(os.environ.get("WORLD_SIZE") or os.environ.get("NUM_PROCESSES") or 1)
+    if world <= 1:
+        return None
+    rank = int(os.environ.get("RANK") or os.environ.get("PROCESS_ID") or 0)
+    host = os.environ.get("MASTER_ADDR", "127.0.0.1")
+    port = int(os.environ.get("HYPERION_COORD_PORT", DEFAULT_PORT))
+    return HostCoordinator(rank, world, host, port, timeout_s)
