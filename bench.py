@@ -5,16 +5,25 @@ reference's own headline microbenchmark (MI250X: 121.07 TFLOPS bf16 at
 8192^2, `Phase 1/results/benchmarks/hardware/precision_results.csv:13`;
 BASELINE.md). `vs_baseline` is achieved/baseline, so 1.0 = parity.
 
-Unlike the reference's sweep (single un-warmed timing including
-allocation — SURVEY §6 caveats), this warms up, runs several fenced
-iterations, and reports the median.
+Measurement integrity (round-2 verdict item #1): on this deployment
+backend `block_until_ready` can return before execution, so a naive
+fence reports dispatch time and once "measured" 41,999 TFLOPS on a
+197-TFLOPS chip. This harness cannot repeat that:
 
-Robustness: the measurement runs in a bounded subprocess so a hung TPU
-backend (round-1 failure mode: axon init never returned) cannot hang the
-driver. On failure this still prints ONE parseable JSON line with
-value 0 and an `error` field naming what to check. A second bounded
-subprocess adds a model-level metric (GPT-2-shaped LM train-step
-tokens/s) as an `extra` field — best-effort, never blocks the primary.
+- K data-dependent matmuls (each consuming the previous output) run
+  inside ONE jit; nothing can elide or overlap them.
+- The timer is fenced by fetching a scalar reduction of the final
+  output to the host — the only wait the backend honours.
+- Per-iteration time is the slope between two chain lengths, removing
+  the fixed dispatch/RPC overhead (~64 ms here) without touching the
+  compute time.
+- Plausibility guards: a result above the chip's nominal peak, a
+  non-finite probe value, or a t(8192)/t(4096) ratio far from the
+  ideal 8x marks the run `implausible` and zeroes `vs_baseline` —
+  a broken fence becomes a reported failure, not a published number.
+
+Robustness: measurements run in bounded subprocesses so a hung backend
+cannot hang the driver; failures still print ONE parseable JSON line.
 """
 
 from __future__ import annotations
@@ -26,48 +35,84 @@ import sys
 
 BASELINE_TFLOPS_BF16_8192 = 121.07  # MI250X bf16 8192^2 (BASELINE.md)
 N = int(os.environ.get("HYPERION_BENCH_N", "8192"))  # override for smoke tests
-ITERS = 10
 PRIMARY_TIMEOUT_S = int(os.environ.get("HYPERION_BENCH_TIMEOUT", "600"))
 EXTRA_TIMEOUT_S = int(os.environ.get("HYPERION_BENCH_EXTRA_TIMEOUT", "420"))
 
 
-def _child_matmul() -> None:
-    import statistics
-    import time
-
+def _chained_matmul_tflops(n: int, k1: int, k2: int):
+    """Sustained bf16 matmul TFLOPS at n^3 via a data-dependent chain."""
     import jax
     import jax.numpy as jnp
 
-    k0, k1 = jax.random.split(jax.random.key(0))
-    a = jax.random.normal(k0, (N, N), jnp.bfloat16)
-    b = jax.random.normal(k1, (N, N), jnp.bfloat16)
+    from hyperion_tpu.utils.timing import time_chained
 
-    @jax.jit
-    def mm(a, b):
-        return a @ b
+    k0, kb = jax.random.split(jax.random.key(0))
+    a = jax.random.normal(k0, (n, n), jnp.bfloat16)
+    b = jax.random.normal(kb, (n, n), jnp.bfloat16)
+    inv_sqrt_n = 1.0 / (n ** 0.5)  # keeps chained values at unit scale
 
-    mm(a, b).block_until_ready()  # compile + warm
-    times = []
-    for _ in range(ITERS):
-        t0 = time.perf_counter()
-        mm(a, b).block_until_ready()
-        times.append(time.perf_counter() - t0)
-    t = statistics.median(times)
-    tflops = (2 * N**3 / t) / 1e12
-    print(json.dumps({
+    def mm(c, b):
+        return (c @ b) * inv_sqrt_n
+
+    res = time_chained(mm, a, b, k1=k1, k2=k2, n_thread=1)
+    tflops = (2 * n**3 / (res.per_iter_ms / 1e3)) / 1e12
+    return tflops, res
+
+
+def _child_matmul() -> None:
+    import math
+
+    import jax
+
+    from hyperion_tpu.utils.chips import device_kind, nominal_peak_tflops
+
+    tflops, res = _chained_matmul_tflops(N, k1=16, k2=48)
+    peak = nominal_peak_tflops("bfloat16")
+
+    # Scaling guard: per-iter time must scale ~N^3 between N/2 and N.
+    scaling_ratio = None
+    if N >= 2048:
+        _, half = _chained_matmul_tflops(N // 2, k1=32, k2=96)
+        if half.per_iter_ms > 0:
+            scaling_ratio = res.per_iter_ms / half.per_iter_ms
+
+    checks = {
+        "probe_finite": math.isfinite(res.probe),
+        "under_peak": peak is None or tflops <= 1.05 * peak,
+        "n_cubed_scaling": scaling_ratio is None or 3.0 <= scaling_ratio <= 20.0,
+    }
+    out = {
         "tflops": round(tflops, 2),
+        "per_iter_ms": round(res.per_iter_ms, 3),
+        "amortized_ms": round(res.amortized_ms, 3),
+        "dispatch_overhead_ms": round(res.overhead_ms, 2),
+        "chain_lengths": [res.k1, res.k2],
+        "peak_tflops": peak,
+        "mfu": round(tflops / peak, 4) if peak else None,
+        "scaling_ratio_vs_half_n": (
+            round(scaling_ratio, 2) if scaling_ratio is not None else None
+        ),
+        "plausible": all(checks.values()),
+        "checks": checks,
         "platform": jax.devices()[0].platform,
-    }))
+        "device_kind": device_kind(),
+    }
+    print(json.dumps(out))
 
 
 def _child_lm_step() -> None:
-    """GPT-2-shaped LM (d768/12h/4L, seq 128) train-step throughput."""
+    """GPT-2-shaped LM (d768/12h/4L, seq 128) train-step throughput.
+
+    The train step is chained by threading (params, opt_state) through
+    scan — each step's gradients depend on the previous step's params,
+    so the per-step time cannot be faked by a lazy fence."""
     import jax
     import jax.numpy as jnp
     import optax
 
     from hyperion_tpu.models.transformer_lm import TransformerLM, gpt2_lm_config
     from hyperion_tpu.train import make_optimizer, next_token_loss
+    from hyperion_tpu.utils.timing import time_chained
 
     bsz, seq = 32, 128
     model = TransformerLM(gpt2_lm_config(dtype="bfloat16", dropout=0.0))
@@ -77,7 +122,6 @@ def _child_lm_step() -> None:
     ids = jax.random.randint(jax.random.key(1), (bsz, seq), 0, 50257, jnp.int32)
     mask = jnp.ones((bsz, seq), jnp.int8)
 
-    @jax.jit
     def step(params, opt_state, ids, mask):
         def loss_fn(p):
             logits = model.apply({"params": p}, ids, padding_mask=mask)
@@ -87,13 +131,14 @@ def _child_lm_step() -> None:
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    from hyperion_tpu.utils.timing import time_fn
-
-    res = time_fn(step, params, opt_state, ids, mask, warmup=2, iters=10)
-    t = res.median_ms / 1e3
+    res = time_chained(step, params, opt_state, ids, mask,
+                       k1=4, k2=12, n_thread=2)
+    t = res.per_iter_ms / 1e3
     print(json.dumps({
-        "lm_step_ms": round(res.median_ms, 2),
+        "lm_step_ms": round(res.per_iter_ms, 2),
+        "lm_step_amortized_ms": round(res.amortized_ms, 2),
         "lm_tokens_per_s": round(bsz * seq / t, 1),
+        "dispatch_overhead_ms": round(res.overhead_ms, 2),
     }))
 
 
@@ -133,17 +178,27 @@ def main() -> None:
             "error": err,
         }))
         sys.exit(0)  # a parseable failure line beats a nonzero rc
+    plausible = bool(primary.get("plausible", False))
     out = {
         "metric": metric,
-        "value": primary["tflops"],
+        "value": primary["tflops"] if plausible else 0.0,
         "unit": "TFLOPS",
         "vs_baseline": (
             round(primary["tflops"] / BASELINE_TFLOPS_BF16_8192, 3)
-            if N == 8192 else 0.0
+            if plausible and N == 8192 else 0.0
         ),
+        "mfu": primary.get("mfu") if plausible else None,
         "platform": primary.get("platform", "unknown"),
+        "device_kind": primary.get("device_kind", "unknown"),
+        "measurement": primary,
     }
-    if N != 8192:
+    if not plausible:
+        out["implausible"] = True
+        out["note"] = (
+            f"guard rejected measurement ({primary.get('checks')}): raw value "
+            f"{primary['tflops']} TFLOPS not published"
+        )
+    elif N != 8192:
         out["note"] = f"smoke run at N={N}; vs_baseline only defined at N=8192"
     extra, extra_err = _run_child("--child-lm-step", EXTRA_TIMEOUT_S)
     if extra is not None:

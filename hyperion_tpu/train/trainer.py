@@ -11,11 +11,12 @@ compiled step → epoch loop → CSV → orbax checkpoint. The DP/FSDP split is
 mesh/sharding config, which is the point of the layout-based design. The
 `language_ddp`/`language_fsdp` job names are kept for CSV/CLI parity.
 
-Timing honesty: JAX dispatch is async; epoch durations are fenced with
-`block_until_ready` so CSV numbers mean what the reference's (sync-point
-`loss.item()` per step) meant. Metrics stay on device during the epoch —
-one host sync per epoch, not per step, which is *less* overhead than the
-reference paid.
+Timing honesty: JAX dispatch is async; epoch durations are fenced with a
+host fetch of the final step's metrics (`utils.timing.host_fence` — a
+bare `block_until_ready` is a no-op on the axon backend) so CSV numbers
+mean what the reference's (sync-point `loss.item()` per step) meant.
+Metrics stay on device during the epoch — one host sync per epoch, not
+per step, which is *less* overhead than the reference paid.
 """
 
 from __future__ import annotations
@@ -50,6 +51,7 @@ from hyperion_tpu.runtime.mesh import make_mesh
 from hyperion_tpu.train.losses import classification_loss, next_token_loss
 from hyperion_tpu.train.state import create_train_state, make_optimizer
 from hyperion_tpu.train.step import make_train_step
+from hyperion_tpu.utils.timing import host_fence
 
 
 @dataclasses.dataclass
@@ -108,7 +110,11 @@ def _epoch_loop(
             device_metrics.append(metrics)  # stays on device until epoch end
             if fence_every_step:
                 jax.block_until_ready(metrics)
-        jax.block_until_ready(device_metrics[-1])
+        # host-fetch fence: on the axon backend block_until_ready can
+        # return before execution, so fetch a scalar of the last step's
+        # metrics (which depends, through the state chain, on every step
+        # of the epoch) before stopping the timer
+        host_fence(device_metrics[-1])
         duration = time.perf_counter() - t0
         loss = _mean_of(device_metrics, "loss")
         extra = extra_cols(device_metrics) if extra_cols else {}

@@ -1,2 +1,10 @@
-from hyperion_tpu.utils.timing import time_fn, TimingResult, sync  # noqa: F401
+from hyperion_tpu.utils.timing import (  # noqa: F401
+    ChainedTimingResult,
+    TimingResult,
+    host_fence,
+    sync,
+    time_chained,
+    time_fn,
+)
+from hyperion_tpu.utils.chips import mfu, nominal_peak_tflops, device_kind  # noqa: F401
 from hyperion_tpu.utils.memory import device_memory_stats, peak_bytes_in_use, live_bytes_in_use  # noqa: F401

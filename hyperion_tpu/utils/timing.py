@@ -2,10 +2,27 @@
 
 Reference timing brackets every measurement with
 `torch.cuda.synchronize()` (`Phase 1/benchmarking.py:37-49`,
-`compilation_optimization.py:105-111`). JAX dispatches asynchronously, so
-naive `time.perf_counter()` around a jitted call measures dispatch, not
-compute — every timer here fences with `jax.block_until_ready` on the
-full output tree (SURVEY §7.3 "epoch-duration parity metrics").
+`compilation_optimization.py:105-111`). JAX dispatches asynchronously,
+and on some remote backends (the axon tunnel this framework deploys on)
+`jax.block_until_ready` returns *before* execution finishes — a bare
+fence measures dispatch, not compute, and round 2's verdict showed it
+reporting a physically impossible 213x-of-peak matmul. Two defenses,
+both used by every benchmark in the tree:
+
+1. **Host-fetch fencing** (`host_fence`): the only wait this backend
+   honours is an actual device->host transfer, so the fence fetches a
+   scalar reduction of the output tree. A timer stopped after
+   `host_fence` has provably waited for the compute feeding it.
+2. **Chained, data-dependent iteration** (`time_chained`): K iterations
+   of the measured function run *inside one jit*, each serialized
+   against the previous via `lax.optimization_barrier` (or by threading
+   outputs into inputs), so no runtime can overlap or elide them.
+   Timing two chain lengths and taking the slope removes the fixed
+   dispatch/RPC overhead (~64 ms on the axon tunnel) from the
+   per-iteration number — the standard two-point method.
+
+`time_fn` (per-call latency, host-fenced) remains for coarse epoch
+timing where per-call overhead is genuinely part of the cost.
 """
 
 from __future__ import annotations
@@ -15,15 +32,47 @@ import time
 from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+from jax import lax
+
+
+def _scalar_probe(tree: Any) -> jax.Array:
+    """One float32 scalar depending on every array leaf of `tree`.
+
+    Uses each leaf's first element, not a full reduction: XLA's slice
+    depends on the complete producing op, so fetching the probe still
+    waits for all the compute, but the probe itself adds O(1) work —
+    it cannot distort a bandwidth-bound measurement the way an
+    O(output-size) sum would."""
+    total = jnp.float32(0)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not hasattr(leaf, "dtype") or leaf.size == 0:
+            continue
+        first = jax.numpy.ravel(leaf)[0]
+        if jnp.issubdtype(leaf.dtype, jnp.bool_):
+            first = first.astype(jnp.int32)
+        if jnp.issubdtype(first.dtype, jnp.number):
+            total = total + first.astype(jnp.float32)
+    return total
+
+
+def host_fence(tree: Any = None) -> float:
+    """Fence that a lazy backend cannot fake: fetch a scalar reduction
+    of `tree` to the host and return it. With no argument, falls back to
+    `jax.effects_barrier()` (best-effort)."""
+    if tree is None:
+        jax.effects_barrier()
+        return 0.0
+    return float(jax.device_get(_scalar_probe(tree)))
 
 
 def sync(tree: Any = None) -> None:
-    """Fence: wait for `tree` (or all in-flight work) to finish."""
+    """Wait for `tree` (or all in-flight work) to finish."""
     if tree is None:
         jax.effects_barrier()
     else:
-        jax.block_until_ready(tree)
+        host_fence(tree)
 
 
 @dataclasses.dataclass
@@ -48,15 +97,18 @@ def time_fn(
     iters: int = 20,
     **kwargs: Any,
 ) -> TimingResult:
-    """Time ``fn(*args)`` with warmup (absorbs compilation) and
-    block_until_ready fencing per iteration."""
+    """Per-call latency with warmup and a host-fetch fence per iteration.
+
+    Includes per-call dispatch overhead (which on a remote backend can
+    dominate for small ops) — use `time_chained` for kernel-level
+    numbers."""
     for _ in range(warmup):
-        sync(fn(*args, **kwargs))
+        host_fence(fn(*args, **kwargs))
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
         out = fn(*args, **kwargs)
-        sync(out)
+        host_fence(out)
         times.append((time.perf_counter() - t0) * 1e3)
     arr = np.asarray(times)
     return TimingResult(
@@ -66,4 +118,106 @@ def time_fn(
         median_ms=float(np.median(arr)),
         iters=iters,
         times_ms=times,
+    )
+
+
+@dataclasses.dataclass
+class ChainedTimingResult:
+    """Per-iteration time from two chain lengths (k1 < k2).
+
+    `per_iter_ms` is the slope ((t2-t1)/(k2-k1)) — fixed launch/RPC
+    overhead removed; this is the sustained kernel time. `amortized_ms`
+    is t2/k2 — a conservative upper bound that still contains 1/k2 of
+    the overhead. `overhead_ms` is the fixed cost estimate. `probe`
+    is the fetched scalar — callers should check it is finite."""
+
+    per_iter_ms: float
+    amortized_ms: float
+    overhead_ms: float
+    k1: int
+    k2: int
+    t1_ms: float
+    t2_ms: float
+    probe: float
+
+    def throughput(self, items_per_call: int) -> float:
+        return items_per_call / (self.per_iter_ms / 1e3)
+
+
+def _build_chain(
+    fn: Callable[..., Any], length: int, n_thread: int
+) -> Callable[..., jax.Array]:
+    """A jitted function running `fn` `length` times, serialized.
+
+    If `n_thread > 0`, the first `n_thread` outputs of `fn` replace the
+    first `n_thread` args each iteration (natural state threading, e.g.
+    a train step). Otherwise args are constant and iterations are
+    serialized through `lax.optimization_barrier`, which pins each call
+    after the previous call's output with no mathematical change."""
+
+    @jax.jit
+    def chained(*args):
+        def body(carry, _):
+            cur_args, acc = carry
+            out = fn(*cur_args)
+            probe = _scalar_probe(out)
+            if n_thread:
+                new_head = out if n_thread > 1 else (out,)
+                nxt = tuple(new_head[:n_thread]) + tuple(cur_args[n_thread:])
+            else:
+                # tie the (unchanged) args to this iteration's output so
+                # the next call cannot start, or be CSE'd, before it
+                nxt, _p = lax.optimization_barrier((tuple(cur_args), probe))
+            return (nxt, acc + probe), ()
+
+        (_, acc), _ = lax.scan(
+            body, (tuple(args), jnp.float32(0)), None, length=length
+        )
+        return acc
+
+    return chained
+
+
+def time_chained(
+    fn: Callable[..., Any],
+    *args: Any,
+    k1: int = 8,
+    k2: int = 24,
+    reps: int = 3,
+    n_thread: int = 0,
+) -> ChainedTimingResult:
+    """Sustained per-iteration time of `fn(*args)` via two chain lengths.
+
+    Each chain is one jit containing k data-dependent iterations; the
+    timer is fenced by fetching the chain's scalar probe to the host.
+    Returns the slope-based per-iteration time (see
+    ChainedTimingResult)."""
+    if not (0 < k1 < k2):
+        raise ValueError(f"need 0 < k1 < k2, got {k1=} {k2=}")
+    c1 = _build_chain(fn, k1, n_thread)
+    c2 = _build_chain(fn, k2, n_thread)
+    probe = float(jax.device_get(c1(*args)))  # compile + warm
+    float(jax.device_get(c2(*args)))
+
+    def best(c) -> float:
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(jax.device_get(c(*args)))
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    t1, t2 = best(c1), best(c2)
+    slope = (t2 - t1) / (k2 - k1)
+    if slope <= 0:  # noise swamped the difference; fall back to amortized
+        slope = t2 / k2
+    return ChainedTimingResult(
+        per_iter_ms=slope * 1e3,
+        amortized_ms=t2 / k2 * 1e3,
+        overhead_ms=max(0.0, (t1 - k1 * slope)) * 1e3,
+        k1=k1,
+        k2=k2,
+        t1_ms=t1 * 1e3,
+        t2_ms=t2 * 1e3,
+        probe=probe,
     )
