@@ -64,10 +64,11 @@ def _child_matmul() -> None:
 
     import jax
 
-    from hyperion_tpu.utils.chips import device_kind, nominal_peak_tflops
+    from hyperion_tpu.utils.chips import device_kind, mfu, nominal_peak_tflops
 
     tflops, res = _chained_matmul_tflops(N, k1=16, k2=48)
     peak = nominal_peak_tflops("bfloat16")
+    util = mfu(tflops, "bfloat16")
 
     # Scaling guard: per-iter time must scale ~N^3 between N/2 and N.
     scaling_ratio = None
@@ -88,7 +89,7 @@ def _child_matmul() -> None:
         "dispatch_overhead_ms": round(res.overhead_ms, 2),
         "chain_lengths": [res.k1, res.k2],
         "peak_tflops": peak,
-        "mfu": round(tflops / peak, 4) if peak else None,
+        "mfu": round(util, 4) if util is not None else None,
         "scaling_ratio_vs_half_n": (
             round(scaling_ratio, 2) if scaling_ratio is not None else None
         ),

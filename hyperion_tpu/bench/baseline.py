@@ -11,9 +11,12 @@ JAX-native decomposition: three separately-jitted programs —
   fwd            logits only
   fwd+bwd        loss + grads
   fwd+bwd+opt    full optimizer step
-Each timed with fenced warm iterations. XLA fuses each program globally,
-so "bwd time" = t(fwd+bwd) − t(fwd) measures the *marginal* cost exactly
-as the reference's subtraction did.
+Each timed as a chain of data-dependent iterations inside one jit with
+per-iteration time from the slope of two chain lengths
+(`utils.timing.time_chained`) — honest under the lazy-fence backend
+round 2 exposed, with fixed dispatch overhead excluded. XLA fuses each
+program globally, so "bwd time" = t(fwd+bwd) − t(fwd) measures the
+*marginal* cost exactly as the reference's subtraction did.
 
 CLI: `python -m hyperion_tpu.bench.baseline [--models ...] [--batch-sizes ...]`.
 """
@@ -35,7 +38,7 @@ from hyperion_tpu.models.encoder import TransformerEncoder, custom_transformer_c
 from hyperion_tpu.models.resnet import resnet50
 from hyperion_tpu.models.vit import ViT, vit_b16_config
 from hyperion_tpu.utils.memory import peak_bytes_in_use
-from hyperion_tpu.utils.timing import time_fn
+from hyperion_tpu.utils.timing import time_chained
 
 
 def _resnet50_spec(batch: int, dtype: str):
@@ -102,25 +105,42 @@ def benchmark_model(
                 out.astype(jnp.float32), y).mean()
         return jnp.mean((out - y) ** 2)  # reference uses MSE for the encoder
 
-    fwd = jax.jit(lambda p, bs, x, y: loss_fn(p, bs, x, y))
-    fwd_bwd = jax.jit(lambda p, bs, x, y: jax.grad(loss_fn)(p, bs, x, y))
+    def fwd(p, bs, x, y):
+        return loss_fn(p, bs, x, y)  # scalar output -> probe is free
 
-    @jax.jit
-    def full_step(p, bs, opt_state, x, y):
+    def fwd_bwd(p, bs, x, y):
+        # thread params through an epsilon-update so each iteration's
+        # backward depends on the previous one WITHOUT a per-iteration
+        # probe reduction (which would skew the bwd-minus-fwd
+        # subtraction); 1e-30*g is numerically a no-op but the compiler
+        # cannot elide it
+        g = jax.grad(loss_fn)(p, bs, x, y)
+        return jax.tree_util.tree_map(
+            lambda a, b: a - jnp.asarray(1e-30, a.dtype) * b.astype(a.dtype),
+            p, g,
+        )
+
+    def full_step(p, opt_state, bs, x, y):
         grads = jax.grad(loss_fn)(p, bs, x, y)
         updates, opt_state = tx.update(grads, opt_state, p)
         return optax.apply_updates(p, updates), opt_state
 
-    t_fwd = time_fn(fwd, params, batch_stats, x, y, warmup=warmup, iters=iters)
-    t_bwd = time_fn(fwd_bwd, params, batch_stats, x, y, warmup=warmup, iters=iters)
-    t_full = time_fn(full_step, params, batch_stats, opt_state, x, y,
-                     warmup=warmup, iters=iters)
+    del warmup  # chains warm themselves; kept for CLI compat
+    k2 = max(6, min(iters, 16))
+    k1 = max(2, k2 // 3)
+    # every chain threads real state -> no probe rides in any timed
+    # region, so the subtraction decomposition stays comparable
+    t_fwd = time_chained(fwd, params, batch_stats, x, y, k1=k1, k2=k2)
+    t_bwd = time_chained(fwd_bwd, params, batch_stats, x, y,
+                         k1=k1, k2=k2, n_thread=1)
+    t_full = time_chained(full_step, params, opt_state, batch_stats, x, y,
+                          k1=k1, k2=k2, n_thread=2)
 
     # decomposition by subtraction, clamped at 0 (fusion can make a
     # superset program faster than the sum of its parts)
-    fwd_ms = t_fwd.mean_ms
-    bwd_ms = max(t_bwd.mean_ms - fwd_ms, 0.0)
-    opt_ms = max(t_full.mean_ms - t_bwd.mean_ms, 0.0)
+    fwd_ms = t_fwd.per_iter_ms
+    bwd_ms = max(t_bwd.per_iter_ms - fwd_ms, 0.0)
+    opt_ms = max(t_full.per_iter_ms - t_bwd.per_iter_ms, 0.0)
 
     peak = peak_bytes_in_use()
     return {
@@ -130,9 +150,10 @@ def benchmark_model(
         "forward_ms": round(fwd_ms, 3),
         "backward_ms": round(bwd_ms, 3),
         "optimizer_ms": round(opt_ms, 3),
-        "total_ms": round(t_full.mean_ms, 3),
+        "total_ms": round(t_full.per_iter_ms, 3),
         "peak_memory_mb": round(peak / 1e6, 2),
         "samples_per_s": round(t_full.throughput(batch), 2),
+        "dispatch_overhead_ms": round(t_full.overhead_ms, 2),
     }
 
 

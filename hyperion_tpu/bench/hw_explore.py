@@ -8,8 +8,12 @@ element: 2 reads + 1 write of fp32). MI250X results: 121.07 TFLOPS bf16
 
 Better-than-reference methodology (SURVEY §6 caveats): the reference
 timed a *single* un-warmed matmul per (size, dtype), including
-allocation; here every point is warmed (absorbing compilation) and the
-median of several fenced iterations. Columns stay comparable.
+allocation; here every point is a chain of data-dependent iterations
+inside one jit, fenced by a host fetch, with per-iteration time from
+the slope of two chain lengths (`utils.timing.time_chained`) — immune
+to the lazy-fence failure mode round 2 exposed, and with fixed dispatch
+overhead removed. Columns stay comparable; `mfu`/`peak_tflops` are
+added (reference reports raw TFLOPS only).
 
 CLI: `python -m hyperion_tpu.bench.hw_explore [--sizes ...] [--out dir]`.
 """
@@ -24,8 +28,10 @@ from pathlib import Path
 import jax
 import jax.numpy as jnp
 
+from hyperion_tpu.utils.chips import mfu as chip_mfu
+from hyperion_tpu.utils.chips import nominal_peak_tflops
 from hyperion_tpu.utils.memory import device_memory_stats
-from hyperion_tpu.utils.timing import time_fn
+from hyperion_tpu.utils.timing import time_chained
 
 MATMUL_SIZES = (1024, 2048, 4096, 8192)
 # fp16 included for column parity with the reference sweep; on TPU the
@@ -51,6 +57,7 @@ def device_report() -> dict:
 def matmul_tflops(
     sizes=MATMUL_SIZES, dtypes=MATMUL_DTYPES, iters: int = 10
 ) -> list[dict]:
+    del iters  # chain lengths are fixed; kept for CLI compat
     rows = []
     for size in sizes:
         for dtype in dtypes:
@@ -58,13 +65,25 @@ def matmul_tflops(
             k0, k1 = jax.random.split(jax.random.key(size))
             a = jax.random.normal(k0, (size, size), dt)
             b = jax.random.normal(k1, (size, size), dt)
-            mm = jax.jit(lambda a, b: a @ b)
-            t = time_fn(mm, a, b, warmup=3, iters=iters)
-            tflops = (2 * size**3 / (t.median_ms / 1e3)) / 1e12
+            inv = jnp.asarray(1.0 / size**0.5, dt)  # keep chain at unit scale
+            # fp32 inputs default to one bf16 MXU pass on TPU; request
+            # true-fp32 precision so the column means what the
+            # reference's real-fp32 measurement meant (36.44 TFLOPS)
+            prec = jax.lax.Precision.HIGHEST if dtype == "float32" else None
+
+            def mm(c, b):
+                return jnp.matmul(c, b, precision=prec) * inv
+
+            t = time_chained(mm, a, b, k1=8, k2=24, n_thread=1)
+            tflops = (2 * size**3 / (t.per_iter_ms / 1e3)) / 1e12
+            util = chip_mfu(tflops, dtype)
             rows.append({
                 "size": size, "dtype": dtype,
-                "time_ms": round(t.median_ms, 4),
+                "time_ms": round(t.per_iter_ms, 4),
                 "tflops": round(tflops, 2),
+                "peak_tflops": nominal_peak_tflops(dtype),
+                "mfu": round(util, 4) if util is not None else None,
+                "dispatch_overhead_ms": round(t.overhead_ms, 2),
             })
     return rows
 
@@ -72,17 +91,27 @@ def matmul_tflops(
 def memory_bandwidth(
     elem_counts=BANDWIDTH_ELEMS, iters: int = 10
 ) -> list[dict]:
+    del iters  # chain lengths are fixed; kept for CLI compat
     rows = []
-    add = jax.jit(lambda x, y: x + y)
+
+    def add(x, y):
+        # averaging keeps the chain numerically stable; the *0.5 fuses
+        # into the add, so traffic stays 2 reads + 1 write per element
+        return (x + y) * 0.5
+
     for n in elem_counts:
         k0, k1 = jax.random.split(jax.random.key(n))
         x = jax.random.normal(k0, (n,), jnp.float32)
         y = jax.random.normal(k1, (n,), jnp.float32)
-        t = time_fn(add, x, y, warmup=3, iters=iters)
-        gbps = (n * BYTES_PER_ELEM / (t.median_ms / 1e3)) / 1e9
+        # threaded chain (z feeds the next x): every output element is
+        # consumed by the next iteration, so nothing can be elided and
+        # no per-iteration probe rides along with the measurement
+        t = time_chained(add, x, y, k1=8, k2=24, n_thread=1)
+        gbps = (n * BYTES_PER_ELEM / (t.per_iter_ms / 1e3)) / 1e9
         rows.append({
-            "elements": n, "time_ms": round(t.median_ms, 4),
+            "elements": n, "time_ms": round(t.per_iter_ms, 4),
             "gb_per_s": round(gbps, 2),
+            "dispatch_overhead_ms": round(t.overhead_ms, 2),
         })
         del x, y
     return rows

@@ -1,30 +1,45 @@
-"""Flash attention — the in-tree Pallas kernel for the framework's hot op.
+"""Flash attention — the in-tree Pallas kernels for the framework's hot op.
 
 Role in the stack (SURVEY §2.3): the reference's "tuned kernel" tier is
 TorchInductor/Triton via `torch.compile(mode="max-autotune")`
-(`compilation_optimization.py:96-103`); ours is this kernel, selected
-with `attention_impl="pallas"` and benchmarked against the plain-XLA
-attention by `compile_bench`.
+(`compilation_optimization.py:96-103`); ours is this kernel pair,
+selected with `attention_impl="pallas"` and benchmarked against the
+plain-XLA attention by `compile_bench`.
 
 Design (classic flash attention, TPU-shaped):
-  * grid (batch, heads, q-blocks); per program: one q tile in VMEM,
-    online-softmax sweep over kv tiles with a `fori_loop`, running
-    (m, l, acc) carried in fp32 registers/VMEM.
-  * logits and softmax statistics in fp32 (`preferred_element_type`),
-    p·v accumulation in fp32, cast to the input dtype at the end.
-  * causal programs stop their kv sweep at the diagonal tile — the
-    standard ~2x FLOP saving — and the in-tile diagonal is masked with
-    broadcasted iotas.
-  * padding masks ([B, T], 1 = real) ride in as a (1, T) block per
-    batch row.
 
-Backward: `jax.custom_vjp` whose bwd recomputes attention with the plain
-XLA formulation and differentiates that — numerically identical
-gradients, flash-speed forward. A hand-written flash backward kernel is
-the known next step (tracked in compile_bench as "pallas-fwd" tier).
+  * Forward: grid (batch, heads, q-blocks, kv-blocks) with the kv axis
+    innermost and `dimension_semantics` marking it "arbitrary" — the kv
+    sweep for one q tile revisits VMEM scratch (m, l, acc) across grid
+    steps, so VMEM only ever holds one (block_q, block_kv) tile pair.
+    K/V stream through as grid blocks; nothing loads a whole sequence,
+    which is what makes the kernel a flash kernel beyond T~2k.
+  * Online softmax in fp32; p*v accumulation in fp32; output cast to
+    the input dtype at the end. The log-sum-exp per row is written as a
+    second output — the residual the backward needs.
+  * Causal programs skip kv tiles past the diagonal (`pl.when`) and
+    mask the in-tile diagonal with broadcasted iotas — the standard
+    ~2x FLOP saving.
+  * Padding masks ([B, T], 1 = real) ride in as (1, block_kv) tiles.
 
-On non-TPU backends the kernel runs in interpret mode so the full test
-suite exercises it on the simulated CPU mesh.
+  * Backward: the standard two-pass recomputation. A host-side
+    `delta = sum(dO * O, -1)` (one fused XLA reduction), then two
+    kernels that recompute the scaled logits tile-by-tile from q/k and
+    the saved log-sum-exp (no [T, T] materialization anywhere):
+      - dq kernel: grid (B, H, q-blocks, kv-blocks), dq accumulated in
+        VMEM scratch over the kv sweep;
+      - dk/dv kernel: grid (B, H, kv-blocks, q-blocks) — the transposed
+        sweep — accumulating dk and dv in scratch over q tiles.
+    p = exp(s - lse) reconstructs the softmax exactly (no per-tile max
+    bookkeeping needed since lse is a true row constant).
+
+Fully-masked rows (all-padding) produce garbage o/lse; their upstream
+gradients are zero under any masked loss, and every backward term is
+multiplied by dO or delta (both zero there), so gradients stay clean —
+same caveat as every standard flash implementation.
+
+On non-TPU backends the kernels run in interpret mode so the full test
+suite exercises them on the simulated CPU mesh.
 """
 
 from __future__ import annotations
@@ -35,8 +50,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-from hyperion_tpu.ops.attention import NEG_INF, _xla_attention, causal_mask
+from hyperion_tpu.ops.attention import NEG_INF
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_KV = 128
@@ -46,69 +62,95 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _compiler_params():
+    if _interpret():
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+    )
+
+
+def _tile_mask(s, qi, ki, block_q, block_kv, causal, pad_ref):
+    """Causal/padding mask for one (block_q, block_kv) logits tile."""
+    mask = None
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0
+        )
+        kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        mask = kv_pos <= q_pos
+    if pad_ref is not None:
+        pad = pad_ref[0] > 0  # (block_kv,)
+        pad = jnp.broadcast_to(pad[None, :], s.shape)
+        mask = pad if mask is None else jnp.logical_and(mask, pad)
+    if mask is None:
+        return s
+    return jnp.where(mask, s, NEG_INF)
+
+
+# ---------------------------------------------------------------- forward
+
+
 def _fwd_kernel(
-    *refs,
-    causal: bool, sm_scale: float, block_q: int, block_kv: int, kv_len: int,
+    *refs, causal: bool, sm_scale: float,
+    block_q: int, block_kv: int, n_kv: int,
 ):
-    # q_ref: (1, 1, block_q, D); k/v_ref: (1, 1, kv_len, D);
-    # pad_ref: (1, kv_len) int8, present only when a padding mask is
-    # passed (pallas hands refs positionally: inputs then outputs).
-    if len(refs) == 5:
-        q_ref, k_ref, v_ref, pad_ref, o_ref = refs
+    # positional refs: inputs (q, k, v[, pad]), outputs (o, lse),
+    # scratch (m, l, acc)
+    if len(refs) == 9:
+        q_ref, k_ref, v_ref, pad_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
     else:
-        q_ref, k_ref, v_ref, o_ref = refs
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
         pad_ref = None
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (block_q, D)
+    ki = pl.program_id(3)
 
-    n_kv_blocks = pl.cdiv(kv_len, block_kv)
-    if causal:
-        # sweep only to the tile containing this q block's last row
-        n_kv_blocks = jnp.minimum(
-            n_kv_blocks, pl.cdiv((qi + 1) * block_q, block_kv)
-        )
+    @pl.when(ki == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
 
-    def body(kv_i, carry):
-        m_prev, l_prev, acc = carry
-        kv_start = kv_i * block_kv
-        k = k_ref[0, 0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
+    # causal: tiles fully above the diagonal contribute nothing
+    relevant = (
+        jnp.bool_(True) if not causal
+        else ki * block_kv <= qi * block_q + block_q - 1
+    )
+
+    @pl.when(relevant)
+    def _update():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (block_q, D)
+        k = k_ref[0, 0].astype(jnp.float32)             # (block_kv, D)
+        v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_q, block_kv)
+        s = _tile_mask(s, qi, ki, block_q, block_kv, causal, pad_ref)
 
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 0
-        )
-        kv_pos = kv_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 1
-        )
-        mask = jnp.ones_like(s, jnp.bool_)
-        if causal:
-            mask = kv_pos <= q_pos
-        if pad_ref is not None:
-            pad = pad_ref[0, pl.ds(kv_start, block_kv)] > 0  # (block_kv,)
-            mask = jnp.logical_and(mask, pad[None, :])
-        s = jnp.where(mask, s, NEG_INF)
-
+        m_prev, l_prev = m_s[...], l_s[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        m_s[...] = m_new
+        l_s[...] = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_s[...] = acc_s[...] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, acc
 
-    D = q_ref.shape[-1]
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, D), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_kv_blocks, body, (m0, l0, acc0))
-    o = acc / jnp.maximum(l, 1e-30)[:, None]
-    o_ref[0, 0] = o.astype(o_ref.dtype)
+    last_ki = (
+        n_kv - 1 if not causal
+        else jnp.minimum(n_kv - 1, (qi * block_q + block_q - 1) // block_kv)
+    )
+
+    @pl.when(ki == last_ki)
+    def _finalize():
+        l = jnp.maximum(l_s[...], 1e-30)
+        o_ref[0, 0] = (acc_s[...] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_s[...] + jnp.log(l)
 
 
 def _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv):
@@ -125,14 +167,17 @@ def _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv):
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
+    n_q, n_kv = Tq // block_q, Tkv // block_kv
 
-    grid = (B, H, Tq // block_q)
-    qspec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0))
-    kvspec = pl.BlockSpec((1, 1, Tkv, D), lambda b, h, i: (b, h, 0, 0))
+    grid = (B, H, n_q, n_kv)
+    qspec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    kvspec = pl.BlockSpec((1, 1, block_kv, D), lambda b, h, i, j: (b, h, j, 0))
     in_specs = [qspec, kvspec, kvspec]
     args = [qT, kT, vT]
     if padding_mask is not None:
-        in_specs.append(pl.BlockSpec((1, Tkv), lambda b, h, i: (b, 0)))
+        in_specs.append(
+            pl.BlockSpec((1, block_kv), lambda b, h, i, j: (b, j))
+        )
         args.append(padding_mask.astype(jnp.int8))
 
     kernel = functools.partial(
@@ -141,23 +186,253 @@ def _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv):
         sm_scale=1.0 / (D ** 0.5),
         block_q=block_q,
         block_kv=block_kv,
-        kv_len=Tkv,
+        n_kv=n_kv,
     )
 
-    out = pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct(qT.shape, q.dtype),
+        out_specs=[
+            qspec,
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qT.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(*args)
-    return out.transpose(0, 2, 1, 3)
+    return o.transpose(0, 2, 1, 3), lse
+
+
+# ---------------------------------------------------------------- backward
+
+
+def _dq_kernel(
+    *refs, causal: bool, sm_scale: float,
+    block_q: int, block_kv: int, n_kv: int,
+):
+    # inputs (q, k, v, do, lse, delta[, pad]), output dq, scratch dq_acc
+    if len(refs) == 9:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, pad_ref, dq_ref, dq_s = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, dq_s = refs
+        pad_ref = None
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    relevant = (
+        jnp.bool_(True) if not causal
+        else ki * block_kv <= qi * block_q + block_q - 1
+    )
+
+    @pl.when(relevant)
+    def _update():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]       # (block_q,)
+        delta = dl_ref[0, 0]      # (block_q,)
+
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        s = _tile_mask(s, qi, ki, block_q, block_kv, causal, pad_ref)
+        p = jnp.exp(s - lse[:, None])                      # exact softmax
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        dq_s[...] = dq_s[...] + sm_scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    last_ki = (
+        n_kv - 1 if not causal
+        else jnp.minimum(n_kv - 1, (qi * block_q + block_q - 1) // block_kv)
+    )
+
+    @pl.when(ki == last_ki)
+    def _finalize():
+        dq_ref[0, 0] = dq_s[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    *refs, causal: bool, sm_scale: float,
+    block_q: int, block_kv: int, n_q: int,
+):
+    # inputs (q, k, v, do, lse, delta[, pad]), outputs (dk, dv),
+    # scratch (dk_acc, dv_acc); grid is (B, H, kv-blocks, q-blocks)
+    if len(refs) == 11:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, pad_ref,
+         dk_ref, dv_ref, dk_s, dv_s) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+         dk_ref, dv_ref, dk_s, dv_s) = refs
+        pad_ref = None
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    # causal: q tiles strictly above this kv tile's diagonal see nothing
+    relevant = (
+        jnp.bool_(True) if not causal
+        else qi * block_q + block_q - 1 >= ki * block_kv
+    )
+
+    @pl.when(relevant)
+    def _update():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = dl_ref[0, 0]
+
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_kv)
+        s = _tile_mask(s, qi, ki, block_q, block_kv, causal, pad_ref)
+        p = jnp.exp(s - lse[:, None])
+        # dv += p^T do
+        dv_s[...] = dv_s[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        # dk += scale * ds^T q
+        dk_s[...] = dk_s[...] + sm_scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, padding_mask, o, lse, g, causal, block_q, block_kv
+):
+    B, Tq, H, D = q.shape
+    Tkv = k.shape[1]
+    block_q = min(block_q, Tq)
+    block_kv = min(block_kv, Tkv)
+    n_q, n_kv = Tq // block_q, Tkv // block_kv
+
+    # delta_i = sum_d dO_id * O_id — one fused XLA reduction, [B, H, Tq]
+    delta = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)
+
+    qT = q.transpose(0, 2, 1, 3)
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+    gT = g.transpose(0, 2, 1, 3)
+
+    sm_scale = 1.0 / (D ** 0.5)
+    qspec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    kvspec_dq = pl.BlockSpec(
+        (1, 1, block_kv, D), lambda b, h, i, j: (b, h, j, 0)
+    )
+    rowspec = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i))
+
+    dq_in_specs = [qspec, kvspec_dq, kvspec_dq, qspec, rowspec, rowspec]
+    dq_args = [qT, kT, vT, gT, lse, delta]
+    if padding_mask is not None:
+        dq_in_specs.append(
+            pl.BlockSpec((1, block_kv), lambda b, h, i, j: (b, j))
+        )
+        dq_args.append(padding_mask.astype(jnp.int8))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_kv=block_kv, n_kv=n_kv,
+        ),
+        grid=(B, H, n_q, n_kv),
+        in_specs=dq_in_specs,
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(qT.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(*dq_args)
+
+    # transposed sweep: kv tiles outer, q tiles inner
+    qspec_t = pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0))
+    kvspec_t = pl.BlockSpec(
+        (1, 1, block_kv, D), lambda b, h, j, i: (b, h, j, 0)
+    )
+    rowspec_t = pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, h, i))
+
+    dkv_in_specs = [qspec_t, kvspec_t, kvspec_t, qspec_t, rowspec_t, rowspec_t]
+    dkv_args = [qT, kT, vT, gT, lse, delta]
+    if padding_mask is not None:
+        dkv_in_specs.append(
+            pl.BlockSpec((1, block_kv), lambda b, h, j, i: (b, j))
+        )
+        dkv_args.append(padding_mask.astype(jnp.int8))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_kv=block_kv, n_q=n_q,
+        ),
+        grid=(B, H, n_kv, n_q),
+        in_specs=dkv_in_specs,
+        out_specs=[kvspec_t, kvspec_t],
+        out_shape=[
+            jax.ShapeDtypeStruct(kT.shape, k.dtype),
+            jax.ShapeDtypeStruct(vT.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, D), jnp.float32),
+            pltpu.VMEM((block_kv, D), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(*dkv_args)
+
+    return (
+        dq.transpose(0, 2, 1, 3),
+        dk.transpose(0, 2, 1, 3),
+        dv.transpose(0, 2, 1, 3),
+    )
+
+
+# ---------------------------------------------------------------- public
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
 def _flash(causal, block_q, block_kv, q, k, v, padding_mask):
-    return _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv)
+    out, _ = _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv)
+    return out
 
 
 def flash_attention(
@@ -169,27 +444,16 @@ def flash_attention(
     return _flash(causal, block_q, block_kv, q, k, v, padding_mask)
 
 
-def _xla_reference(q, k, v, padding_mask, causal):
-    mask = None
-    if causal:
-        mask = causal_mask(q.shape[1], k.shape[1])[None, None]
-    if padding_mask is not None:
-        pad = padding_mask[:, None, None, :].astype(jnp.bool_)
-        mask = pad if mask is None else jnp.logical_and(mask, pad)
-    return _xla_attention(q, k, v, mask)
-
-
 def _fwd(causal, block_q, block_kv, q, k, v, padding_mask):
-    out = _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv)
-    return out, (q, k, v, padding_mask)
+    out, lse = _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv)
+    return out, (q, k, v, padding_mask, out, lse)
 
 
 def _bwd(causal, block_q, block_kv, residuals, g):
-    q, k, v, padding_mask = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: _xla_reference(q, k, v, padding_mask, causal), q, k, v
+    q, k, v, padding_mask, o, lse = residuals
+    dq, dk, dv = _flash_backward(
+        q, k, v, padding_mask, o, lse, g, causal, block_q, block_kv
     )
-    dq, dk, dv = vjp(g)
     # integer mask cotangent is float0 (None when no mask was passed)
     dmask = (
         None if padding_mask is None

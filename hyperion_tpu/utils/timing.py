@@ -38,22 +38,23 @@ from jax import lax
 
 
 def _scalar_probe(tree: Any) -> jax.Array:
-    """One float32 scalar depending on every array leaf of `tree`.
+    """One float32 scalar that consumes EVERY element of every leaf.
 
-    Uses each leaf's first element, not a full reduction: XLA's slice
-    depends on the complete producing op, so fetching the probe still
-    waits for all the compute, but the probe itself adds O(1) work —
-    it cannot distort a bandwidth-bound measurement the way an
-    O(output-size) sum would."""
+    A full reduction, deliberately: a cheaper probe (slicing one
+    element) lets XLA fuse the slice into the producer and dead-code-
+    eliminate the rest of the measured op — verified on this backend
+    (an elementwise add "ran" at petabytes/s). Consuming all elements
+    makes elision impossible; the reduction's own cost only matters in
+    barrier-mode chains, where callers account for it (threaded chains
+    probe once, after the timed region)."""
     total = jnp.float32(0)
     for leaf in jax.tree_util.tree_leaves(tree):
         if not hasattr(leaf, "dtype") or leaf.size == 0:
             continue
-        first = jax.numpy.ravel(leaf)[0]
         if jnp.issubdtype(leaf.dtype, jnp.bool_):
-            first = first.astype(jnp.int32)
-        if jnp.issubdtype(first.dtype, jnp.number):
-            total = total + first.astype(jnp.float32)
+            leaf = leaf.astype(jnp.int32)
+        if jnp.issubdtype(leaf.dtype, jnp.number):
+            total = total + jnp.sum(leaf).astype(jnp.float32)
     return total
 
 
@@ -151,23 +152,35 @@ def _build_chain(
 
     If `n_thread > 0`, the first `n_thread` outputs of `fn` replace the
     first `n_thread` args each iteration (natural state threading, e.g.
-    a train step). Otherwise args are constant and iterations are
-    serialized through `lax.optimization_barrier`, which pins each call
-    after the previous call's output with no mathematical change."""
+    a train step): every element of each iteration's output is consumed
+    by the next, so nothing can be elided, and the only probe is one
+    full-sum of the final carry *after* the timed iterations.
+
+    Otherwise args are constant: each iteration's output is consumed by
+    a full-sum probe (preventing dead-code elimination) and the next
+    call is pinned after it via `lax.optimization_barrier`. The
+    reduction rides along with the measured op; for elementwise ops
+    prefer a threaded chain, which has zero per-iteration overhead."""
 
     @jax.jit
     def chained(*args):
+        if n_thread:
+            def body(carry, _):
+                out = fn(*carry)
+                new_head = out if n_thread > 1 else (out,)
+                nxt = tuple(new_head[:n_thread]) + tuple(carry[n_thread:])
+                return nxt, ()
+
+            final, _ = lax.scan(body, tuple(args), None, length=length)
+            return _scalar_probe(final[:n_thread])
+
         def body(carry, _):
             cur_args, acc = carry
             out = fn(*cur_args)
             probe = _scalar_probe(out)
-            if n_thread:
-                new_head = out if n_thread > 1 else (out,)
-                nxt = tuple(new_head[:n_thread]) + tuple(cur_args[n_thread:])
-            else:
-                # tie the (unchanged) args to this iteration's output so
-                # the next call cannot start, or be CSE'd, before it
-                nxt, _p = lax.optimization_barrier((tuple(cur_args), probe))
+            # tie the (unchanged) args to this iteration's output so
+            # the next call cannot start, or be CSE'd, before it
+            nxt, _p = lax.optimization_barrier((tuple(cur_args), probe))
             return (nxt, acc + probe), ()
 
         (_, acc), _ = lax.scan(
@@ -185,29 +198,45 @@ def time_chained(
     k2: int = 24,
     reps: int = 3,
     n_thread: int = 0,
+    min_window_s: float = 0.1,
+    max_k2: int = 1024,
 ) -> ChainedTimingResult:
     """Sustained per-iteration time of `fn(*args)` via two chain lengths.
 
     Each chain is one jit containing k data-dependent iterations; the
     timer is fenced by fetching the chain's scalar probe to the host.
-    Returns the slope-based per-iteration time (see
-    ChainedTimingResult)."""
+    Chain lengths auto-grow until the t2-t1 window exceeds
+    `min_window_s`, so dispatch/RPC jitter (a few ms on the axon
+    tunnel) cannot swamp the slope for small ops. Returns the
+    slope-based per-iteration time (see ChainedTimingResult)."""
     if not (0 < k1 < k2):
         raise ValueError(f"need 0 < k1 < k2, got {k1=} {k2=}")
-    c1 = _build_chain(fn, k1, n_thread)
-    c2 = _build_chain(fn, k2, n_thread)
-    probe = float(jax.device_get(c1(*args)))  # compile + warm
-    float(jax.device_get(c2(*args)))
 
-    def best(c) -> float:
-        ts = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            float(jax.device_get(c(*args)))
-            ts.append(time.perf_counter() - t0)
-        return min(ts)
+    def measure(k1: int, k2: int) -> tuple[float, float, float]:
+        c1 = _build_chain(fn, k1, n_thread)
+        c2 = _build_chain(fn, k2, n_thread)
+        probe = float(jax.device_get(c1(*args)))  # compile + warm
+        float(jax.device_get(c2(*args)))
 
-    t1, t2 = best(c1), best(c2)
+        def best(c) -> float:
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                float(jax.device_get(c(*args)))
+                ts.append(time.perf_counter() - t0)
+            return min(ts)
+
+        return best(c1), best(c2), probe
+
+    t1, t2, probe = measure(k1, k2)
+    while (t2 - t1) < min_window_s and k2 < max_k2:
+        window = max(t2 - t1, 1e-4)
+        factor = min(max_k2 // k2, max(2, int(min_window_s / window) + 1))
+        if factor < 2:
+            break
+        k1, k2 = k1 * factor, k2 * factor
+        t1, t2, probe = measure(k1, k2)
+
     slope = (t2 - t1) / (k2 - k1)
     if slope <= 0:  # noise swamped the difference; fall back to amortized
         slope = t2 / k2
