@@ -56,7 +56,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--grad_accum", type=int, default=1)
-    p.add_argument("--remat", choices=["none", "full"], default="none")
+    p.add_argument("--remat",
+                   choices=["none", "full", "dots", "dots_no_batch"],
+                   default="none",
+                   help="activation-remat policy (precision.remat): full = "
+                        "recompute everything; dots keeps matmul outputs")
+    p.add_argument("--compile-tier", choices=["jit", "jit+pallas"],
+                   default="jit",
+                   help="jit+pallas swaps in the in-tree flash-attention "
+                        "and fused-norm kernels (max-autotune analogue)")
+    p.add_argument("--attention-impl", choices=["xla", "pallas"], default=None,
+                   help="override just the attention kernel, leaving norms "
+                        "on the tier default")
     return p
 
 
@@ -86,6 +97,8 @@ def make_config(args, job: str) -> Config:
     cfg.optimization.precision = args.precision
     cfg.optimization.grad_accum_steps = args.grad_accum
     cfg.optimization.remat = args.remat
+    cfg.optimization.compile_tier = args.compile_tier
+    cfg.optimization.attention_impl = args.attention_impl
     if job in ("language_fsdp", "llama"):
         cfg.optimization.grad_clip_norm = 1.0  # reference clip 1.0 (:351,522)
     cfg.distributed.max_devices = args.devices

@@ -50,6 +50,7 @@ class OptimizationConfig:
     grad_accum_steps: int = 1
     grad_clip_norm: float = 0.0      # 0 disables (FSDP loops use 1.0)
     compile_tier: str = "jit"        # jit | jit+pallas (compile_bench variants)
+    attention_impl: str | None = None  # override just attention: xla | pallas
     donate_state: bool = True        # buffer donation into the train step
 
 

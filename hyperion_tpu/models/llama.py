@@ -49,8 +49,19 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     attention_impl: str = "xla"
-    remat: bool = True            # 7B needs remat on any realistic chip
+    norm_impl: str = "xla"        # xla | pallas (fused_rmsnorm kernel)
+    # 7B needs remat on any realistic chip; False/"none", True/"full",
+    # or a named precision.remat policy ("dots", "dots_no_batch")
+    remat: bool | str = True
     dtype: str = "bfloat16"
+
+    @property
+    def remat_policy(self) -> str:
+        if self.remat is False:
+            return "none"
+        if self.remat is True:
+            return "full"
+        return self.remat
 
     @property
     def head_dim(self) -> int:
@@ -79,13 +90,18 @@ def llama_tiny_config(**kw) -> LlamaConfig:
 class RMSNorm(nn.Module):
     eps: float
     dtype: jnp.dtype
+    impl: str = "xla"  # "pallas" → fused single-HBM-pass kernel
 
     @nn.compact
     def __call__(self, x):
+        w = self.param("weight", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        if self.impl == "pallas":
+            from hyperion_tpu.ops.pallas.fused_norm import fused_rmsnorm
+
+            return fused_rmsnorm(x, w, eps=self.eps)
         # variance in fp32 (bf16 squares underflow), scale in compute dtype
         var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
         normed = x * jax.lax.rsqrt(var + self.eps).astype(x.dtype)
-        w = self.param("weight", nn.initializers.ones, (x.shape[-1],), jnp.float32)
         return normed * w.astype(self.dtype)
 
 
@@ -153,9 +169,9 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x, rope_table, padding_mask):
         c = self.cfg
-        h = RMSNorm(c.norm_eps, c.compute_dtype, name="input_norm")(x)
+        h = RMSNorm(c.norm_eps, c.compute_dtype, c.norm_impl, name="input_norm")(x)
         x = x + LlamaAttention(c, name="attn")(h, rope_table, padding_mask)
-        h = RMSNorm(c.norm_eps, c.compute_dtype, name="post_attn_norm")(x)
+        h = RMSNorm(c.norm_eps, c.compute_dtype, c.norm_impl, name="post_attn_norm")(x)
         return x + LlamaMLP(c, name="mlp")(h)
 
 
@@ -172,11 +188,13 @@ class Llama(nn.Module):
         )(input_ids)
         rope = rope_frequencies(c.head_dim, c.max_len, c.rope_theta)
         block = LlamaBlock
-        if c.remat:
-            block = nn.remat(LlamaBlock)
+        if c.remat_policy != "none":
+            from hyperion_tpu.precision.remat import REMAT_POLICIES
+
+            block = nn.remat(LlamaBlock, policy=REMAT_POLICIES[c.remat_policy])
         for i in range(c.n_layers):
             x = block(c, name=f"layer_{i}")(x, rope, padding_mask)
-        x = RMSNorm(c.norm_eps, c.compute_dtype, name="final_norm")(x)
+        x = RMSNorm(c.norm_eps, c.compute_dtype, c.norm_impl, name="final_norm")(x)
         logits = nn.Dense(
             c.vocab_size, use_bias=False, dtype=c.compute_dtype,
             kernel_init=nn.initializers.normal(0.02), name="lm_head",

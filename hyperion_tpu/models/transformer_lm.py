@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from hyperion_tpu.data.text import GPT2_VOCAB_SIZE
 from hyperion_tpu.ops.attention import dot_product_attention
+from hyperion_tpu.ops.pallas.fused_norm import fused_layernorm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,9 +45,20 @@ class TransformerLMConfig:
     dropout: float = 0.1
     activation: str = "relu"       # relu | gelu
     attention_impl: str = "xla"    # xla | pallas
+    norm_impl: str = "xla"         # xla | pallas (fused_layernorm kernel)
     causal: bool = True            # False → bidirectional encoder blocks
-    remat: bool = False            # jax.checkpoint each block
+    # rematerialisation: False/"none", True/"full", or a named policy
+    # from precision.remat.REMAT_POLICIES ("dots", "dots_no_batch")
+    remat: bool | str = False
     dtype: str = "float32"         # compute dtype; params stay fp32
+
+    @property
+    def remat_policy(self) -> str:
+        if self.remat is False:
+            return "none"
+        if self.remat is True:
+            return "full"
+        return self.remat
 
     @property
     def head_dim(self) -> int:
@@ -96,6 +108,28 @@ class MHA(nn.Module):
         )(out)
 
 
+class FusedLayerNorm(nn.Module):
+    """nn.LayerNorm-compatible module (same `scale`/`bias` params, so
+    checkpoints swap freely between impls) backed by the Pallas
+    `fused_layernorm` kernel — the norm half of the `jit+pallas` tier."""
+
+    dtype: jnp.dtype
+    eps: float = 1e-6  # nn.LayerNorm default, for param/output parity
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (d,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (d,), jnp.float32)
+        return fused_layernorm(x.astype(self.dtype), scale, bias, eps=self.eps)
+
+
+def _norm(cfg, name: str):
+    if cfg.norm_impl == "pallas":
+        return FusedLayerNorm(dtype=cfg.compute_dtype, name=name)
+    return nn.LayerNorm(dtype=cfg.compute_dtype, name=name)
+
+
 class Block(nn.Module):
     cfg: TransformerLMConfig
 
@@ -103,11 +137,11 @@ class Block(nn.Module):
     def __call__(self, x, padding_mask, deterministic: bool):
         c = self.cfg
         act = {"relu": nn.relu, "gelu": nn.gelu}[c.activation]
-        h = nn.LayerNorm(dtype=c.compute_dtype, name="ln1")(x)
+        h = _norm(c, "ln1")(x)
         h = MHA(c, name="attn")(h, padding_mask, deterministic)
         h = nn.Dropout(c.dropout, deterministic=deterministic)(h)
         x = x + h
-        h = nn.LayerNorm(dtype=c.compute_dtype, name="ln2")(x)
+        h = _norm(c, "ln2")(x)
         h = nn.Dense(c.ff_dim, dtype=c.compute_dtype, name="fc1")(h)
         h = act(h)
         h = nn.Dense(c.d_model, dtype=c.compute_dtype, name="fc2")(h)
@@ -146,11 +180,16 @@ class TransformerLM(nn.Module):
         x = nn.Dropout(c.dropout, deterministic=deterministic)(x)
 
         block = Block
-        if c.remat:
-            block = nn.remat(Block, static_argnums=(3,))
+        if c.remat_policy != "none":
+            from hyperion_tpu.precision.remat import REMAT_POLICIES
+
+            block = nn.remat(
+                Block, static_argnums=(3,),
+                policy=REMAT_POLICIES[c.remat_policy],
+            )
         for i in range(c.n_layers):
             x = block(c, name=f"block_{i}")(x, padding_mask, deterministic)
-        x = nn.LayerNorm(dtype=c.compute_dtype, name="ln_f")(x)
+        x = _norm(c, "ln_f")(x)
         logits = nn.Dense(
             c.vocab_size,
             dtype=c.compute_dtype,

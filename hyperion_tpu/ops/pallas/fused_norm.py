@@ -1,10 +1,12 @@
-"""Fused residual-add + LayerNorm Pallas kernel.
+"""Fused residual-add + LayerNorm / RMSNorm Pallas kernels.
 
 The second of the two "tuned tier" kernels (SURVEY §7.1: "fused
 attention, fused LN/residual"). XLA usually fuses LN chains well on its
-own — this kernel exists to (a) guarantee the fusion (one HBM round-trip
-for `residual + x` → normalize → scale/shift) and (b) be the measurable
-Pallas-vs-XLA data point `compile_bench` reports alongside attention.
+own — these kernels exist to (a) guarantee the fusion (one HBM
+round-trip for `residual + x` → normalize → scale/shift) and (b) be the
+measurable Pallas-vs-XLA data point `compile_bench` reports alongside
+attention. `fused_rmsnorm` is the Llama-family variant (no mean
+subtraction, no bias — matches `models.llama.RMSNorm`).
 
 Statistics are computed in fp32 regardless of input dtype (bf16 mean/var
 is exactly where LN goes wrong); the normalized output is cast back.
@@ -42,7 +44,10 @@ def _kernel_no_res(x_ref, w_ref, b_ref, o_ref, *, eps: float):
     _kernel(x_ref, None, w_ref, b_ref, o_ref, eps=eps)
 
 
-def _forward(x, residual, weight, bias, eps, block_rows):
+def _row_blocked_call(kernel, x, extra_row_args, vec_args, block_rows):
+    """Shared scaffolding for row-wise norm kernels: flatten to
+    (rows, d), tile rows into blocks, broadcast the [d]-shaped vectors
+    to every block, run one fused pass."""
     orig_shape = x.shape
     d = orig_shape[-1]
     x2 = x.reshape(-1, d)
@@ -52,16 +57,11 @@ def _forward(x, residual, weight, bias, eps, block_rows):
         block = rows  # odd row counts: single block (still one fused pass)
 
     row_spec = pl.BlockSpec((block, d), lambda i: (i, 0))
-    wb_spec = pl.BlockSpec((d,), lambda i: (0,))
-    if residual is not None:
-        args = [x2, residual.reshape(-1, d), weight, bias]
-        in_specs = [row_spec, row_spec, wb_spec, wb_spec]
-        kernel = functools.partial(_kernel, eps=eps)
-    else:
-        args = [x2, weight, bias]
-        in_specs = [row_spec, wb_spec, wb_spec]
-        kernel = functools.partial(_kernel_no_res, eps=eps)
-
+    vec_spec = pl.BlockSpec((d,), lambda i: (0,))
+    args = [x2] + [a.reshape(-1, d) for a in extra_row_args] + list(vec_args)
+    in_specs = (
+        [row_spec] * (1 + len(extra_row_args)) + [vec_spec] * len(vec_args)
+    )
     out = pl.pallas_call(
         kernel,
         grid=(rows // block,),
@@ -71,6 +71,18 @@ def _forward(x, residual, weight, bias, eps, block_rows):
         interpret=_interpret(),
     )(*args)
     return out.reshape(orig_shape)
+
+
+def _forward(x, residual, weight, bias, eps, block_rows):
+    if residual is not None:
+        return _row_blocked_call(
+            functools.partial(_kernel, eps=eps),
+            x, [residual], [weight, bias], block_rows,
+        )
+    return _row_blocked_call(
+        functools.partial(_kernel_no_res, eps=eps),
+        x, [], [weight, bias], block_rows,
+    )
 
 
 def _reference(x, residual, weight, bias, eps):
@@ -115,3 +127,51 @@ def _bwd(eps, block_rows, res, g):
 
 
 _fused.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------- RMSNorm
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(ms + eps) * w_ref[:].astype(jnp.float32)
+    o_ref[:] = y.astype(o_ref.dtype)
+
+
+def _rms_forward(x, weight, eps, block_rows):
+    return _row_blocked_call(
+        functools.partial(_rms_kernel, eps=eps), x, [], [weight], block_rows
+    )
+
+
+def _rms_reference(x, weight, eps):
+    h = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(h), -1, keepdims=True)
+    return (h * jax.lax.rsqrt(ms + eps) * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _fused_rms(eps, block_rows, x, weight):
+    return _rms_forward(x, weight, eps, block_rows)
+
+
+def fused_rmsnorm(
+    x, weight, *, eps: float = 1e-5, block_rows: int = DEFAULT_BLOCK_ROWS
+):
+    """`x * rsqrt(mean(x^2) + eps) * weight` in one HBM pass.
+    x: [..., d]; weight: [d]."""
+    return _fused_rms(eps, block_rows, x, weight)
+
+
+def _rms_fwd(eps, block_rows, x, weight):
+    return _rms_forward(x, weight, eps, block_rows), (x, weight)
+
+
+def _rms_bwd(eps, block_rows, res, g):
+    x, weight = res
+    _, vjp = jax.vjp(lambda x, w: _rms_reference(x, w, eps), x, weight)
+    return vjp(g)
+
+
+_fused_rms.defvjp(_rms_fwd, _rms_bwd)

@@ -139,6 +139,17 @@ def _epoch_loop(
     return state, history
 
 
+def _tier_impls(cfg: Config) -> dict[str, str]:
+    """`optimization.compile_tier` → model kernel-impl kwargs. The
+    "jit+pallas" tier (the reference's max-autotune analogue,
+    `compilation_optimization.py:96-103`) swaps in the in-tree Pallas
+    flash-attention and fused-norm kernels with one flag."""
+    pallas = cfg.optimization.compile_tier in ("jit+pallas", "pallas")
+    impl = "pallas" if pallas else "xla"
+    attn = cfg.optimization.attention_impl or impl
+    return {"attention_impl": attn, "norm_impl": impl}
+
+
 def _build_mesh(cfg: Config):
     devices = None
     if cfg.distributed.max_devices:
@@ -188,11 +199,13 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
     )
 
     policy = get_policy(cfg.optimization.precision)
+    tier_impl = _tier_impls(cfg)
     model = TransformerLM(simple_lm_config(
         max_len=cfg.train.seq_len,
         dropout=0.1,
-        remat=cfg.optimization.remat != "none",
+        remat=cfg.optimization.remat,
         dtype=jnp.dtype(policy.compute_dtype).name,
+        **tier_impl,
     ))
     optimizer = make_optimizer(
         cfg.train.learning_rate, cfg.train.weight_decay,
@@ -316,9 +329,14 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
     mesh = _build_mesh(cfg)
     n_dev = mesh.devices.size
 
+    tier_impl = _tier_impls(cfg)
     llcfg = (
-        llama_tiny_config() if cfg.train.model == "llama_tiny"
-        else llama2_7b_config(max_len=max(cfg.train.seq_len, 128))
+        llama_tiny_config(**tier_impl) if cfg.train.model == "llama_tiny"
+        else llama2_7b_config(
+            max_len=max(cfg.train.seq_len, 128),
+            remat=cfg.optimization.remat if cfg.optimization.remat != "none" else True,
+            **tier_impl,
+        )
     )
     model = Llama(llcfg)
     mode = "lora_bf16" if cfg.train.lora else "fsdp_bf16"

@@ -11,7 +11,7 @@ import pytest
 
 from hyperion_tpu.ops.attention import dot_product_attention
 from hyperion_tpu.ops.pallas.flash_attention import flash_attention
-from hyperion_tpu.ops.pallas.fused_norm import fused_layernorm
+from hyperion_tpu.ops.pallas.fused_norm import fused_layernorm, fused_rmsnorm
 
 
 def qkv(shape=(2, 64, 4, 16), seed=0, dtype=jnp.float32):
@@ -193,6 +193,62 @@ class TestFusedLayerNorm:
         for a, b_ in zip(ga, gb):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        atol=1e-4, rtol=1e-4)
+
+    def test_rmsnorm_matches_reference(self):
+        x = jax.random.normal(jax.random.key(0), (4, 16, 32))
+        w = jax.random.normal(jax.random.key(1), (32,)) + 1.0
+        out = fused_rmsnorm(x, w, eps=1e-5)
+        ref = x * jax.lax.rsqrt(jnp.mean(x**2, -1, keepdims=True) + 1e-5) * w
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_rmsnorm_gradients(self):
+        x = jax.random.normal(jax.random.key(0), (8, 16))
+        w = jnp.ones(16) * 1.5
+
+        def loss(x, w):
+            return jnp.sum(fused_rmsnorm(x, w) ** 2)
+
+        def ref_loss(x, w):
+            y = x * jax.lax.rsqrt(jnp.mean(x**2, -1, keepdims=True) + 1e-5) * w
+            return jnp.sum(y ** 2)
+
+        ga = jax.grad(loss, argnums=(0, 1))(x, w)
+        gb = jax.grad(ref_loss, argnums=(0, 1))(x, w)
+        for a, b in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_llama_norm_impl_equivalence(self):
+        """norm_impl='pallas' must match the XLA RMSNorm in-model."""
+        from hyperion_tpu.models.llama import Llama, llama_tiny_config
+
+        xla = Llama(llama_tiny_config(norm_impl="xla"))
+        pls = Llama(llama_tiny_config(norm_impl="pallas"))
+        params = xla.init_params(jax.random.key(0), seq=32)
+        ids = jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 32)),
+                          jnp.int32)
+        a = xla.apply({"params": params}, ids)
+        b = pls.apply({"params": params}, ids)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5, rtol=3e-5)
+
+    def test_lm_full_pallas_tier_equivalence(self):
+        """attention_impl + norm_impl both pallas ≡ both xla."""
+        from hyperion_tpu.models.transformer_lm import TransformerLM, simple_lm_config
+
+        kw = dict(vocab_size=128, d_model=32, n_heads=2, n_layers=2,
+                  ff_dim=64, max_len=32, dropout=0.0)
+        xla = TransformerLM(simple_lm_config(**kw))
+        pls = TransformerLM(simple_lm_config(
+            attention_impl="pallas", norm_impl="pallas", **kw))
+        params = xla.init_params(jax.random.key(0))
+        ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 32)),
+                          jnp.int32)
+        a = xla.apply({"params": params}, ids)
+        b = pls.apply({"params": params}, ids)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5, rtol=3e-5)
 
     def test_bf16_stats_in_fp32(self):
         x = (jax.random.normal(jax.random.key(0), (4, 64)) * 100).astype(jnp.bfloat16)

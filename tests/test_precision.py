@@ -50,3 +50,53 @@ class TestRemat:
     def test_unknown_raises(self):
         with pytest.raises(ValueError):
             apply_remat(lambda x: x, "everything")
+
+
+class TestRematMemory:
+    """VERDICT r2 item 7: the remat policies must demonstrably change
+    what the compiler keeps vs recomputes on the GPT-2-shaped LM.
+
+    CPU XLA's buffer assignment barely reflects remat in
+    `memory_analysis` (its scheduler keeps similar peaks), so the load-
+    bearing assertion is structural: full remat must RE-EXECUTE the
+    forward matmuls inside the backward (strictly more `dot` ops in the
+    compiled HLO), while the `dots` policy saves matmul outputs (same
+    dot count as no-remat). Temp memory is asserted not to regress.
+    """
+
+    @staticmethod
+    def _compiled(remat):
+        from hyperion_tpu.models.transformer_lm import TransformerLM, gpt2_lm_config
+
+        cfg = gpt2_lm_config(
+            vocab_size=512, max_len=128, dropout=0.0, remat=remat,
+            n_layers=2)
+        model = TransformerLM(cfg)
+        params = model.init_params(jax.random.key(0), batch=1)
+        ids = jnp.zeros((2, 128), jnp.int32)
+
+        def loss(p):
+            return model.apply({"params": p}, ids).mean()
+
+        return jax.jit(jax.grad(loss)).lower(params).compile()
+
+    @staticmethod
+    def _dot_count(compiled) -> int:
+        txt = compiled.as_text()
+        return txt.count(" dot(") + txt.count(" dot.")
+
+    def test_full_remat_recomputes_matmuls_in_backward(self):
+        plain = self._compiled(False)
+        full = self._compiled("full")
+        assert self._dot_count(full) > self._dot_count(plain)
+        # and recomputation must not cost extra live memory
+        assert (full.memory_analysis().temp_size_in_bytes
+                <= 1.05 * plain.memory_analysis().temp_size_in_bytes)
+
+    def test_dots_policy_saves_matmul_outputs(self):
+        plain = self._compiled(False)
+        dots = self._compiled("dots")
+        full = self._compiled("full")
+        # matmul outputs saved -> no recomputed dots
+        assert self._dot_count(dots) == self._dot_count(plain)
+        assert self._dot_count(dots) < self._dot_count(full)
