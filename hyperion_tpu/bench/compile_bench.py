@@ -223,7 +223,9 @@ def summarize(rows: list[dict]) -> str:
         lines.append(f"\n{model}:")
         for variant in VARIANTS:
             r = sub.get(variant)
-            if not r or r["median_ms"] != r["median_ms"]:
+            if r is None:
+                continue  # tier not attempted (e.g. train-step rows)
+            if r["median_ms"] != r["median_ms"]:
                 lines.append(f"  {variant:>10}: failed")
                 continue
             speed = (base / r["median_ms"]) if base else float("nan")

@@ -111,7 +111,7 @@ def memory_bandwidth(
         # a working set that fits on-chip (v5e VMEM is 128 MB; use 2x
         # for safety across chips) never leaves VMEM between chain
         # iterations — that row measures on-chip, not HBM, bandwidth
-        working_set_mb = 3 * n * 4 / 1e6
+        working_set_mb = n * BYTES_PER_ELEM / 1e6
         rows.append({
             "elements": n, "time_ms": round(t.per_iter_ms, 4),
             "gb_per_s": round(gbps, 2),

@@ -58,9 +58,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--grad_accum", type=int, default=1)
     p.add_argument("--remat",
                    choices=["none", "full", "dots", "dots_no_batch"],
-                   default="none",
+                   default=None,
                    help="activation-remat policy (precision.remat): full = "
-                        "recompute everything; dots keeps matmul outputs")
+                        "recompute everything; dots keeps matmul outputs "
+                        "(default: full for llama, none otherwise)")
     p.add_argument("--compile-tier", choices=["jit", "jit+pallas"],
                    default="jit",
                    help="jit+pallas swaps in the in-tree flash-attention "
@@ -96,7 +97,9 @@ def make_config(args, job: str) -> Config:
     cfg.train.model = "llama_tiny" if args.llama_size == "tiny" else "llama_7b"
     cfg.optimization.precision = args.precision
     cfg.optimization.grad_accum_steps = args.grad_accum
-    cfg.optimization.remat = args.remat
+    # 7B llama doesn't fit un-rematerialized on one chip; every other
+    # job defaults to no remat. An explicit --remat always wins.
+    cfg.optimization.remat = args.remat or ("full" if job == "llama" else "none")
     cfg.optimization.compile_tier = args.compile_tier
     cfg.optimization.attention_impl = args.attention_impl
     if job in ("language_fsdp", "llama"):

@@ -143,6 +143,19 @@ def load_token_file(path: str | Path) -> TextSplit:
         return TextSplit(z["input_ids"], z["attention_mask"], source=f"npz:{path}")
 
 
+def load_recordio_split(base: str | Path, split: str) -> TextSplit:
+    """Read a split written by `data.prepare` — ids and mask as native
+    recordio files (memory-mapped C++ reader, SURVEY §2.3 Arrow row)."""
+    from hyperion_tpu.data.recordio import RecordFile
+
+    base = Path(base)
+    with RecordFile(base / f"{split}.ids.rio") as ids_f, \
+         RecordFile(base / f"{split}.mask.rio") as mask_f:
+        ids = ids_f.read_all()
+        mask = mask_f.read_all()
+    return TextSplit(ids, mask, source=f"recordio:{base / split}")
+
+
 def load_wikitext2(
     base_dir: str | Path = "data",
     splits: tuple[str, ...] = ("train", "validation"),
@@ -152,8 +165,9 @@ def load_wikitext2(
 ) -> dict[str, TextSplit]:
     """Load the tokenized corpus, preferring on-disk data and falling
     back per-split to synthetic. Search order per split:
-    `{base}/wikitext2_tokenized/{split}` (arrow dir),
-    `{base}/wikitext2_tokenized/{split}.npz` (our format), synthetic.
+    `{base}/wikitext2_tokenized/{split}.ids.rio` (native recordio, the
+    `data.prepare` output), `{split}/` (HF arrow dir), `{split}.npz`,
+    synthetic.
 
     Synthetic default sizes follow the reference's post-filter split
     sizes (36718/3760/4358 — SURVEY C18), scaled down 8x so CPU test
@@ -167,7 +181,16 @@ def load_wikitext2(
     for i, split in enumerate(splits):
         arrow_dir = base / split
         npz = base / f"{split}.npz"
-        if arrow_dir.is_dir() and list(arrow_dir.glob("data-*.arrow")):
+        s = None
+        if (base / f"{split}.ids.rio").exists():
+            try:  # half-written prepare output falls through, like every
+                s = load_recordio_split(base, split)  # other source
+            except OSError as e:
+                print(f"[load_wikitext2] recordio {split} unreadable "
+                      f"({e}); falling back")
+        if s is not None:
+            pass
+        elif arrow_dir.is_dir() and list(arrow_dir.glob("data-*.arrow")):
             s = load_arrow_split(arrow_dir)
         elif npz.exists():
             s = load_token_file(npz)

@@ -57,11 +57,9 @@ class LlamaConfig:
 
     @property
     def remat_policy(self) -> str:
-        if self.remat is False:
-            return "none"
-        if self.remat is True:
-            return "full"
-        return self.remat
+        from hyperion_tpu.precision.remat import normalize_remat
+
+        return normalize_remat(self.remat)
 
     @property
     def head_dim(self) -> int:

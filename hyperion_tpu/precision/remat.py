@@ -27,6 +27,21 @@ REMAT_POLICIES = {
 }
 
 
+def normalize_remat(value) -> str:
+    """Model configs accept bool (legacy) or policy-name remat values;
+    normalize to a REMAT_POLICIES key. Shared by every model family so
+    the bool handling cannot drift."""
+    if value is False or value is None:
+        return "none"
+    if value is True:
+        return "full"
+    if value not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat policy {value!r}; have {sorted(REMAT_POLICIES)}"
+        )
+    return value
+
+
 def apply_remat(fn, policy: str = "none", prevent_cse: bool = True):
     """Wrap ``fn`` (typically a layer-apply or the whole forward) in
     jax.checkpoint under the named policy. ``"none"`` returns ``fn``

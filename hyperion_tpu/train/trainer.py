@@ -330,11 +330,15 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
     n_dev = mesh.devices.size
 
     tier_impl = _tier_impls(cfg)
+    # the remat flag threads verbatim — '--remat none' must really mean
+    # no remat so the baseline is measurable (the CLI defaults llama to
+    # 'full' since 7B doesn't fit un-rematerialized on a single chip)
     llcfg = (
-        llama_tiny_config(**tier_impl) if cfg.train.model == "llama_tiny"
+        llama_tiny_config(remat=cfg.optimization.remat, **tier_impl)
+        if cfg.train.model == "llama_tiny"
         else llama2_7b_config(
             max_len=max(cfg.train.seq_len, 128),
-            remat=cfg.optimization.remat if cfg.optimization.remat != "none" else True,
+            remat=cfg.optimization.remat,
             **tier_impl,
         )
     )

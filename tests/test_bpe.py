@@ -1,0 +1,141 @@
+"""In-tree byte-level BPE + dataset-prep pipeline (C18 equivalent).
+
+Mirrors the reference's verify habits (dataset_preparation.ipynb:
+reload-verify, split counts) as actual assertions.
+"""
+
+import numpy as np
+import pytest
+
+from hyperion_tpu.data.bpe import ByteBPE, bytes_to_unicode, train_bpe
+from hyperion_tpu.data.prepare import encode_split, filter_nonempty, prepare
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the quick brown fox was here again and again",
+    "pack my box with five dozen liquor jugs",
+    "how vexingly quick daft zebras jump!",
+    "the five boxing wizards jump quickly",
+] * 20
+
+
+def small_tok(vocab_size=400):
+    return train_bpe(CORPUS, vocab_size=vocab_size)
+
+
+class TestByteBPE:
+    def test_byte_alphabet_covers_all_bytes(self):
+        m = bytes_to_unicode()
+        assert len(m) == 256
+        assert len(set(m.values())) == 256  # invertible
+
+    @pytest.mark.parametrize("text", [
+        "the quick brown fox",
+        "Hello, World!  multiple  spaces",
+        "unicode: déjà vu — naïve café",
+        "numbers 12345 and punct !?;:",
+        "tabs\tand\nnewlines",
+    ])
+    def test_encode_decode_roundtrip(self, text):
+        tok = small_tok()
+        assert tok.decode(tok.encode(text)) == text
+
+    def test_merges_actually_compress(self):
+        tok = small_tok()
+        ids = tok.encode("the quick brown fox")
+        n_bytes = len("the quick brown fox".encode())
+        assert len(ids) < n_bytes  # common words merged below byte count
+
+    def test_training_deterministic(self):
+        a, b = small_tok(), small_tok()
+        assert a.merges == b.merges
+        assert a.vocab == b.vocab
+
+    def test_eos_reserved(self):
+        tok = small_tok(vocab_size=300)
+        assert tok.vocab_size <= 300
+        assert tok.eos_id == tok.vocab_size - 1
+
+    def test_save_load_gpt2_format(self, tmp_path):
+        tok = small_tok()
+        tok.save(tmp_path / "tok")
+        assert (tmp_path / "tok" / "vocab.json").exists()
+        assert (tmp_path / "tok" / "merges.txt").exists()
+        tok2 = ByteBPE.load(tmp_path / "tok")
+        text = "the quick brown fox jumps"
+        assert tok.encode(text) == tok2.encode(text)
+
+    def test_save_load_roundtrips_hash_merges(self):
+        """Merges whose symbols start with '#' (markdown/code corpora)
+        must survive save/load — only the '#version' header is special."""
+        corpus = ["## heading one", "## heading two", "# code comment"] * 30
+        tok = train_bpe(corpus, vocab_size=300)
+        assert any(a.startswith("#") for a, b in tok.merges)
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            tok.save(d)
+            tok2 = ByteBPE.load(d)
+        assert tok.merges == tok2.merges
+        text = "## heading one"
+        assert tok.encode(text) == tok2.encode(text)
+
+    def test_unseen_bytes_still_encode(self):
+        tok = small_tok()
+        text = "ünseen →  ☃ bytes"
+        assert tok.decode(tok.encode(text)) == text
+
+
+class TestPrepare:
+    def test_filter_nonempty(self):
+        lines = ["a", "", "  ", "b", "\t"]
+        assert filter_nonempty(lines) == ["a", "b"]
+
+    def test_encode_split_shapes_and_padding(self):
+        tok = small_tok()
+        split = encode_split(tok, CORPUS[:10], seq_len=32)
+        assert split.input_ids.shape == (10, 32)
+        split.verify(vocab_size=tok.vocab_size)
+        # pad region is eos
+        pad = split.input_ids[split.attention_mask == 0]
+        assert (pad == tok.eos_id).all()
+
+    def test_truncation(self):
+        tok = small_tok()
+        long_line = " ".join(CORPUS)
+        split = encode_split(tok, [long_line], seq_len=16)
+        assert split.input_ids.shape == (1, 16)
+        assert split.attention_mask.all()
+
+    def test_prepare_end_to_end_recordio(self, tmp_path):
+        raw = {
+            "train": CORPUS + ["", "   "],
+            "validation": CORPUS[:7] + [""],
+        }
+        out = prepare(raw, base_dir=tmp_path, seq_len=32,
+                      vocab_size=400, verbose=False)
+        assert len(out["train"]) == len(CORPUS)  # empties filtered
+        assert len(out["validation"]) == 7
+        td = tmp_path / "wikitext2_tokenized"
+        for s in ("train", "validation"):
+            assert (td / f"{s}.ids.rio").exists()
+            assert (td / f"{s}.mask.rio").exists()
+        assert (tmp_path / "tokenizer" / "vocab.json").exists()
+
+        # trainers consume the output: load -> verify -> batch
+        from hyperion_tpu.data.text import load_wikitext2
+
+        splits = load_wikitext2(tmp_path, splits=("train",), seq_len=32)
+        assert splits["train"].source.startswith("recordio")
+        np.testing.assert_array_equal(
+            splits["train"].input_ids, out["train"].input_ids)
+
+    def test_prepare_reuses_existing_tokenizer(self, tmp_path):
+        raw = {"train": CORPUS}
+        prepare(raw, base_dir=tmp_path, seq_len=32, vocab_size=400,
+                verbose=False)
+        v1 = (tmp_path / "tokenizer" / "vocab.json").read_text()
+        # second run must load, not retrain (same file content)
+        prepare(raw, base_dir=tmp_path, seq_len=32, vocab_size=999,
+                verbose=False)
+        assert (tmp_path / "tokenizer" / "vocab.json").read_text() == v1
