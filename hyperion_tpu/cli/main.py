@@ -54,6 +54,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scaling_devices", type=int, nargs="*", default=None,
                    help="device counts for --model scaling (default 1,2,4,8 clipped)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--no-validate", action="store_true",
+                   help="skip the per-epoch validation pass")
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--grad_accum", type=int, default=1)
     p.add_argument("--remat",
@@ -92,14 +94,16 @@ def make_config(args, job: str) -> Config:
     cfg.train.learning_rate = args.lr or d["learning_rate"]
     cfg.train.weight_decay = d.get("weight_decay", 0.0)
     cfg.train.steps_per_epoch = args.steps_per_epoch
+    cfg.train.validate = not args.no_validate
     cfg.train.seed = args.seed
     cfg.train.lora = args.lora
     cfg.train.model = "llama_tiny" if args.llama_size == "tiny" else "llama_7b"
     cfg.optimization.precision = args.precision
     cfg.optimization.grad_accum_steps = args.grad_accum
-    # 7B llama doesn't fit un-rematerialized on one chip; every other
-    # job defaults to no remat. An explicit --remat always wins.
-    cfg.optimization.remat = args.remat or ("full" if job == "llama" else "none")
+    # 7B llama doesn't fit un-rematerialized on one chip; tiny llama and
+    # every other job default to no remat. An explicit --remat always wins.
+    needs_remat = job == "llama" and args.llama_size == "7b"
+    cfg.optimization.remat = args.remat or ("full" if needs_remat else "none")
     cfg.optimization.compile_tier = args.compile_tier
     cfg.optimization.attention_impl = args.attention_impl
     if job in ("language_fsdp", "llama"):

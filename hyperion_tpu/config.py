@@ -81,6 +81,7 @@ class TrainConfig:
     weight_decay: float = 0.0
     seq_len: int = 128               # reference tokenization window
     steps_per_epoch: int = 0         # 0 = full pass; >0 caps steps (smoke/bench runs)
+    validate: bool = True            # per-epoch val pass (exceeds reference)
     seed: int = 0
     base_dir: str = "data"
     log_every: int = 50

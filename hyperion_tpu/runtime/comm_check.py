@@ -11,11 +11,17 @@ collective XLA relies on — psum (all-reduce), all_gather, psum_scatter
 verifying numerics per device. This exercises ICI (and DCN on multi-slice)
 exactly where training traffic will flow.
 
-CLI:  python -m hyperion_tpu.runtime.comm_check
+CLI:  python -m hyperion_tpu.runtime.comm_check [--host-only]
+
+`--host-only` exercises just the C++ host-coordination layer (handshake
++ named barriers + liveness) across RANK/WORLD_SIZE processes without
+touching devices — the pre-flight the reference ran `test_nccl.py` for,
+usable before committing chips to a job.
 """
 
 from __future__ import annotations
 
+import argparse
 import sys
 import time
 
@@ -88,7 +94,45 @@ def comm_check(devices=None, verbose: bool = True) -> bool:
     return ok
 
 
+def host_check(rounds: int = 3) -> bool:
+    """Host-layer-only pre-flight: handshake (dist.setup), named
+    barriers, liveness. Device-free, so it runs before chips are
+    committed. Single-process runs report and pass trivially."""
+    import os
+
+    os.environ.setdefault("HYPERION_SKIP_JAX_INIT", "1")
+    try:
+        dist.setup()
+        # same env precedence as dist.setup — a JAX_NUM_PROCESSES launch
+        # must not trivially pass the pre-flight
+        world = int(dist._env_first(dist._ENV_NUM_PROCESSES) or 1)
+        if world <= 1:
+            print("[comm_check] host-only: single process, nothing to sync")
+            return True
+        for i in range(rounds):
+            dist.host_barrier(f"host_check_{i}", timeout_s=30.0)
+        alive = dist.peers_alive()
+        print(f"[comm_check] host-only rank {dist.process_index()}/{world}: "
+              f"{rounds} barriers OK, {alive} hosts alive")
+        dist.cleanup()
+        return alive == world
+    except Exception as e:  # noqa: BLE001 — report, exit 1, like test_nccl
+        print(f"[comm_check] host-only FAILED: {e}")
+        return False
+
+
 def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--host-only", action="store_true",
+                   help="exercise only the C++ host coordinator "
+                        "(no devices needed)")
+    args = p.parse_args(argv)
+
+    if args.host_only:
+        ok = host_check()
+        print(f"[comm_check] {'HOST LAYER OK' if ok else 'FAILURE'}")
+        return 0 if ok else 1
+
     dist.setup()
     n = len(jax.devices())
     print(

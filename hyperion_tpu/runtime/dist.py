@@ -10,6 +10,18 @@ process sees the global device set and collectives ride ICI/DCN.
 Single-host runs (the common dev/bench case, and everything the
 reference's `torchrun --standalone` did) need no rendezvous at all —
 `setup()` is a no-op there, by design rather than accident.
+
+Multi-process runs additionally stand up the in-tree C++ host
+coordinator (`native/coord.cpp` via `runtime.native_coord`) *before*
+JAX's rendezvous: a pre-flight handshake with a hard timeout (the
+reference's `init_process_group(timeout=5min)` semantics,
+`distributed_utils.py:111`), named barriers independent of any device
+computation (the reference's `dist.barrier()` around FSDP checkpoint IO,
+`:369,405`), and fail-fast peer-death detection instead of the hung
+collective the reference's disabled NCCL watchdog would have left
+(`run_language_fsdp.sh:10`). Set `HYPERION_HOST_COORD=0` to disable;
+`HYPERION_SKIP_JAX_INIT=1` runs the host layer alone (pre-flight checks
+and the 2-process CPU tests).
 """
 
 from __future__ import annotations
@@ -23,6 +35,8 @@ import jax
 log = logging.getLogger(__name__)
 
 _INITIALIZED = False
+_HOST_COORD = None
+_HOST_RANK: int | None = None
 
 # torchrun-style env compatibility: the reference reads RANK/WORLD_SIZE
 # (run_distributed.py:73-79); JAX's native names are also honored.
@@ -51,7 +65,7 @@ def setup(
     """Initialize the multi-host runtime if (and only if) this run spans
     more than one process. Safe to call unconditionally, like the
     reference's `setup(rank, world)`."""
-    global _INITIALIZED
+    global _INITIALIZED, _HOST_COORD, _HOST_RANK
     if _INITIALIZED:
         return
     num_processes = num_processes or int(_env_first(_ENV_NUM_PROCESSES) or 1)
@@ -63,6 +77,28 @@ def setup(
         else int(_env_first(_ENV_PROCESS_ID) or 0)
     )
     coordinator_address = coordinator_address or _env_first(_ENV_COORDINATOR)
+
+    # pre-flight host handshake: every peer must be reachable within the
+    # timeout BEFORE we commit to the JAX rendezvous, and a dead peer
+    # later turns into a CoordError instead of a hung collective
+    if _HOST_COORD is None and os.environ.get("HYPERION_HOST_COORD", "1") != "0":
+        from hyperion_tpu.runtime.native_coord import DEFAULT_PORT, HostCoordinator
+
+        host = (coordinator_address or "127.0.0.1").split(":")[0]
+        port = int(os.environ.get("HYPERION_COORD_PORT", DEFAULT_PORT))
+        _HOST_COORD = HostCoordinator(
+            rank=process_id, world=num_processes, host=host, port=port,
+            timeout_s=init_timeout_s,
+        )
+        _HOST_RANK = process_id
+        log.info("host coordinator up (rank %d/%d via %s)",
+                 process_id, num_processes, host)
+
+    if os.environ.get("HYPERION_SKIP_JAX_INIT") == "1":
+        _HOST_RANK = process_id
+        _INITIALIZED = True
+        return
+
     if coordinator_address and ":" not in coordinator_address:
         coordinator_address = f"{coordinator_address}:{DEFAULT_COORD_PORT}"
     log.info(
@@ -82,14 +118,21 @@ def cleanup() -> None:
     """Tear down the runtime (reference `cleanup()`: barrier + destroy PG,
     distributed_utils.py:122-125). Barrier first so no process exits while
     a peer still has collectives in flight."""
-    global _INITIALIZED
+    global _INITIALIZED, _HOST_COORD, _HOST_RANK
     if _INITIALIZED:
         barrier("cleanup")
-        jax.distributed.shutdown()
+        if jax.process_count() > 1:
+            jax.distributed.shutdown()
         _INITIALIZED = False
+    if _HOST_COORD is not None:
+        _HOST_COORD.close()
+        _HOST_COORD = None
+        _HOST_RANK = None
 
 
 def process_index() -> int:
+    if jax.process_count() == 1 and _HOST_RANK is not None:
+        return _HOST_RANK  # host-coordination-only mode (pre-flight/tests)
     return jax.process_index()
 
 
@@ -100,14 +143,34 @@ def process_count() -> int:
 def is_primary() -> bool:
     """True on the process that owns logging/checkpoint duties — the
     'rank 0' of the reference's rank-0-only CSV/checkpoint pattern."""
-    return jax.process_index() == 0
+    return process_index() == 0
+
+
+def host_barrier(name: str = "host", timeout_s: float = 60.0) -> None:
+    """Named host-level barrier through the C++ coordinator — no device
+    work involved, so it is safe around checkpoint/file IO (the
+    reference's `dist.barrier()` placement, distributed_utils.py:369,405)
+    and it FAILS (CoordError) rather than hangs when a peer has died."""
+    if _HOST_COORD is not None:
+        log.debug("host_barrier %s", name)
+        _HOST_COORD.barrier(timeout_s)
+
+
+def peers_alive() -> int:
+    """Coordinator's count of live hosts; process_count() when the host
+    layer is off (single process or disabled)."""
+    if _HOST_COORD is not None:
+        return _HOST_COORD.alive_count()
+    return jax.process_count()
 
 
 def barrier(name: str = "barrier") -> None:
     """Cross-process sync point (reference: dist.barrier(),
     distributed_utils.py:369,405). On a single process this is a
     device-flush, which preserves the 'everything before me finished'
-    meaning for timing code."""
+    meaning for timing code. Multi-process: host-level barrier first
+    (fail-fast on dead peers), then the device-level sync."""
+    host_barrier(name)
     if jax.process_count() == 1:
         jax.effects_barrier()
         return

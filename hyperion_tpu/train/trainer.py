@@ -34,7 +34,7 @@ from hyperion_tpu.config import Config
 from hyperion_tpu.data.sharding import ShardedBatches
 from hyperion_tpu.data.text import load_wikitext2
 from hyperion_tpu.data.vision import load_cifar10
-from hyperion_tpu.metrics.csv_logger import CsvLogger
+from hyperion_tpu.metrics.csv_logger import SCHEMAS, CsvLogger
 from hyperion_tpu.models.llama import Llama, llama2_7b_config, llama_tiny_config, load_hf_checkpoint
 from hyperion_tpu.models.lora import (
     LoraConfig,
@@ -50,7 +50,7 @@ from hyperion_tpu.runtime import dist
 from hyperion_tpu.runtime.mesh import make_mesh
 from hyperion_tpu.train.losses import classification_loss, next_token_loss
 from hyperion_tpu.train.state import create_train_state, make_optimizer
-from hyperion_tpu.train.step import make_train_step
+from hyperion_tpu.train.step import make_eval_step, make_train_step
 from hyperion_tpu.utils.timing import host_fence
 
 
@@ -92,6 +92,9 @@ def _epoch_loop(
     extra_cols: Callable[[list], dict] | None = None,
     ckpt_dir: str | None = None,
     resume_epoch: int = 0,
+    eval_step=None,
+    eval_batches: ShardedBatches | None = None,
+    eval_cols: Callable[[list], dict] | None = None,
 ) -> tuple[Any, list[EpochRecord]]:
     history: list[EpochRecord] = []
     # The simulated-CPU backend's in-process collectives deadlock when the
@@ -115,9 +118,28 @@ def _epoch_loop(
         # metrics (which depends, through the state chain, on every step
         # of the epoch) before stopping the timer
         host_fence(device_metrics[-1])
-        duration = time.perf_counter() - t0
+        duration = time.perf_counter() - t0  # train-only time; val follows
         loss = _mean_of(device_metrics, "loss")
         extra = extra_cols(device_metrics) if extra_cols else {}
+        if eval_step is not None and eval_batches is not None:
+            # validation pass (exceeds the reference, which never
+            # evaluated): deterministic order, no dropout, no grads
+            val_metrics = []
+            for i, vbatch in enumerate(eval_batches.epoch(0)):
+                if max_steps and i >= max_steps:
+                    break
+                val_metrics.append(eval_step(state, vbatch))
+            if val_metrics:
+                host_fence(val_metrics[-1])
+            # eval_cols must handle an empty list (a val split smaller
+            # than one global batch yields zero batches): the schema
+            # already promises the columns, so NaNs beat a missing-column
+            # crash at the end of epoch 1
+            extra.update(
+                eval_cols(val_metrics) if eval_cols
+                else {"val_loss": _mean_of(val_metrics, "loss")
+                      if val_metrics else float("nan")}
+            )
         row = EpochRecord(epoch + 1, loss, duration, extra)
         history.append(row)
         logger.log(
@@ -134,9 +156,23 @@ def _epoch_loop(
                 f"loss={loss:.4f}{extras} ({duration:.2f}s)"
             )
         if ckpt_dir:
+            # named host barriers fence the IO the way the reference
+            # bracketed FSDP checkpointing (distributed_utils.py:369,405)
+            # — and fail fast if a peer died mid-epoch
+            dist.host_barrier(f"pre_ckpt_{epoch}")
             ckpt.save(ckpt_dir, state, force=True)
             ckpt.prune(ckpt_dir, keep=2)  # full sharded state per epoch adds up
+            dist.host_barrier(f"post_ckpt_{epoch}")
     return state, history
+
+
+def _lm_eval_cols(vm: list) -> dict:
+    """val_loss + perplexity; NaN when the val split produced zero
+    batches (the schema still promises the columns)."""
+    if not vm:
+        return {"val_loss": float("nan"), "val_ppl": float("nan")}
+    vl = _mean_of(vm, "loss")
+    return {"val_loss": vl, "val_ppl": float(np.exp(min(vl, 20.0)))}
 
 
 def _tier_impls(cfg: Config) -> dict[str, str]:
@@ -157,10 +193,16 @@ def _build_mesh(cfg: Config):
     return make_mesh(cfg.distributed.mesh_spec(), devices=devices)
 
 
-def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int):
+def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int,
+                 extra_schema: tuple = ()):
     """CSV logger + checkpoint-restore/resume bookkeeping shared by every
-    trainer. Returns (logger, ckpt_dir, state, resume_epoch)."""
-    logger = CsvLogger(job, n_devices, cfg.train.base_dir)
+    trainer. Returns (logger, ckpt_dir, state, resume_epoch).
+    `extra_schema` appends columns (e.g. val metrics) after the
+    reference-compatible base columns."""
+    logger = CsvLogger(
+        job, n_devices, cfg.train.base_dir,
+        schema=SCHEMAS[job] + tuple(extra_schema),
+    )
     # world-size-specific, like the reference's run ids: a 2-device run
     # must not resume a 1-device run's checkpoint (their shardings and
     # their scaling-experiment roles differ)
@@ -191,7 +233,8 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
     n_dev = mesh.devices.size
     is_fsdp = job == "language_fsdp" or mesh.shape["fsdp"] > 1
 
-    splits = load_wikitext2(cfg.train.base_dir, splits=("train",),
+    want = ("train", "validation") if cfg.train.validate else ("train",)
+    splits = load_wikitext2(cfg.train.base_dir, splits=want,
                             seq_len=cfg.train.seq_len, seed=cfg.train.seed)
     batches = ShardedBatches(
         splits["train"].arrays(), cfg.train.batch_size, mesh,
@@ -236,13 +279,28 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
         dropout=True,
     )
 
+    eval_step = val_batches = eval_cols = None
+    extra_schema: tuple = ()
+    if cfg.train.validate and "validation" in splits:
+        val_batches = ShardedBatches(
+            splits["validation"].arrays(), cfg.train.batch_size, mesh,
+            shuffle=False, seed=cfg.train.seed,
+        )
+        eval_step = make_eval_step(
+            lambda p, bs, b: {"loss": loss_fn(p, bs, b, None)[0]}, sharding
+        )
+
+        eval_cols = _lm_eval_cols
+        extra_schema = ("val_loss", "val_ppl")
+
     logger, ckpt_dir, state, resume_epoch = _prepare_run(
-        job, cfg, state, batches, n_dev
+        job, cfg, state, batches, n_dev, extra_schema
     )
     state, history = _epoch_loop(
         job=job, cfg=cfg, batches=batches, state=state, train_step=train_step,
         rng=rng, logger=logger, n_devices=n_dev, ckpt_dir=ckpt_dir,
         resume_epoch=resume_epoch,
+        eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
     )
     ckpt.export_gathered(
         f"{cfg.train.base_dir}/checkpoints/{job}_final.npz", state.params
@@ -296,13 +354,45 @@ def train_cifar_model(cfg: Config, job: str = "cifar_ddp") -> TrainResult:
         total = sum(float(m["total"]) for m in device_metrics)
         return {"accuracy": 100.0 * correct / max(total, 1.0)}
 
+    eval_step = val_batches = eval_cols = None
+    extra_schema: tuple = ()
+    if cfg.train.validate and "test" in splits:
+        val_batches = ShardedBatches(
+            splits["test"].arrays(), cfg.train.batch_size, mesh,
+            shuffle=False, seed=cfg.train.seed,
+        )
+
+        def eval_fn(params, batch_stats, batch):
+            logits = model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                batch["images"], train=False,
+            )
+            loss, counts = classification_loss(logits, batch["labels"])
+            return {"loss": loss, **counts}
+
+        eval_step = make_eval_step(eval_fn, sharding)
+
+        def eval_cols(vm: list) -> dict:
+            if not vm:
+                return {"val_loss": float("nan"),
+                        "val_accuracy": float("nan")}
+            correct = sum(float(m["correct"]) for m in vm)
+            total = sum(float(m["total"]) for m in vm)
+            return {
+                "val_loss": _mean_of(vm, "loss"),
+                "val_accuracy": 100.0 * correct / max(total, 1.0),
+            }
+
+        extra_schema = ("val_loss", "val_accuracy")
+
     logger, ckpt_dir, state, resume_epoch = _prepare_run(
-        job, cfg, state, batches, n_dev
+        job, cfg, state, batches, n_dev, extra_schema
     )
     state, history = _epoch_loop(
         job=job, cfg=cfg, batches=batches, state=state, train_step=train_step,
         rng=rng, logger=logger, n_devices=n_dev, extra_cols=accuracy_cols,
         ckpt_dir=ckpt_dir, resume_epoch=resume_epoch,
+        eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
     )
     ckpt.export_gathered(
         f"{cfg.train.base_dir}/checkpoints/{job}_final.npz", state.params
@@ -345,16 +435,21 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
     model = Llama(llcfg)
     mode = "lora_bf16" if cfg.train.lora else "fsdp_bf16"
 
+    want = ("train", "validation") if cfg.train.validate else ("train",)
     splits = load_wikitext2(
-        cfg.train.base_dir, splits=("train",), seq_len=cfg.train.seq_len,
+        cfg.train.base_dir, splits=want, seq_len=cfg.train.seq_len,
         seed=cfg.train.seed,
     )
-    train_split = splits["train"]
-    # clamp synthetic GPT-2-vocab ids into the Llama vocab
-    ids = np.minimum(train_split.input_ids, llcfg.vocab_size - 1)
+
+    def clamped(split):  # clamp synthetic GPT-2-vocab ids into Llama vocab
+        return {
+            "input_ids": np.minimum(split.input_ids, llcfg.vocab_size - 1),
+            "attention_mask": split.attention_mask,
+        }
+
     batches = ShardedBatches(
-        {"input_ids": ids, "attention_mask": train_split.attention_mask},
-        cfg.train.batch_size, mesh, shuffle=True, seed=cfg.train.seed,
+        clamped(splits["train"]), cfg.train.batch_size, mesh,
+        shuffle=True, seed=cfg.train.seed,
     )
 
     lora_cfg = LoraConfig(rank=cfg.train.lora_rank, alpha=cfg.train.lora_alpha)
@@ -426,14 +521,28 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
         donate=cfg.optimization.donate_state,
     )
 
+    eval_step = val_batches = eval_cols = None
+    extra_schema: tuple = ()
+    if cfg.train.validate and "validation" in splits:
+        val_batches = ShardedBatches(
+            clamped(splits["validation"]), cfg.train.batch_size, mesh,
+            shuffle=False, seed=cfg.train.seed,
+        )
+        eval_step = make_eval_step(
+            lambda p, bs, b: {"loss": loss_fn(p, bs, b, None)[0]}, sharding
+        )
+        eval_cols = _lm_eval_cols
+        extra_schema = ("val_loss", "val_ppl")
+
     logger, ckpt_dir, state, resume_epoch = _prepare_run(
-        job, cfg, state, batches, n_dev
+        job, cfg, state, batches, n_dev, extra_schema
     )
     state, history = _epoch_loop(
         job=job, cfg=cfg, batches=batches, state=state, train_step=train_step,
         rng=rng, logger=logger, n_devices=n_dev,
         extra_cols=lambda _: {"mode": mode},
         ckpt_dir=ckpt_dir, resume_epoch=resume_epoch,
+        eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
     )
     # save_pretrained analogue: adapters alone for LoRA, else full params
     export = state.params["lora"] if cfg.train.lora else state.params

@@ -121,7 +121,7 @@ class TestTrainerDrivers:
         assert np.isfinite(res.final_loss)
         assert res.history[1].loss < res.history[0].loss
         rows = [r for r in open(res.csv_path)]
-        assert rows[0].strip() == "epoch,loss,duration_s,gpus"
+        assert rows[0].strip() == "epoch,loss,duration_s,gpus,val_loss,val_ppl"
         assert len(rows) == 3
         assert (tmp_path / "checkpoints" / "language_ddp_final.npz").exists()
 
@@ -153,7 +153,8 @@ class TestTrainerDrivers:
         res = train_cifar_model(cfg)
         assert np.isfinite(res.final_loss)
         rows = [r for r in open(res.csv_path)]
-        assert rows[0].strip() == "epoch,loss,accuracy,duration_s,gpus"
+        assert rows[0].strip() == ("epoch,loss,accuracy,duration_s,gpus,"
+                                   "val_loss,val_accuracy")
         acc = float(rows[1].split(",")[2])
         assert 0.0 <= acc <= 100.0
 
