@@ -37,6 +37,7 @@ log = logging.getLogger(__name__)
 _INITIALIZED = False
 _HOST_COORD = None
 _HOST_RANK: int | None = None
+_JAX_SKIPPED = False  # host-coordination-only mode: never touch the backend
 
 # torchrun-style env compatibility: the reference reads RANK/WORLD_SIZE
 # (run_distributed.py:73-79); JAX's native names are also honored.
@@ -65,7 +66,7 @@ def setup(
     """Initialize the multi-host runtime if (and only if) this run spans
     more than one process. Safe to call unconditionally, like the
     reference's `setup(rank, world)`."""
-    global _INITIALIZED, _HOST_COORD, _HOST_RANK
+    global _INITIALIZED, _HOST_COORD, _HOST_RANK, _JAX_SKIPPED
     if _INITIALIZED:
         return
     num_processes = num_processes or int(_env_first(_ENV_NUM_PROCESSES) or 1)
@@ -80,11 +81,15 @@ def setup(
 
     # pre-flight host handshake: every peer must be reachable within the
     # timeout BEFORE we commit to the JAX rendezvous, and a dead peer
-    # later turns into a CoordError instead of a hung collective
-    if _HOST_COORD is None and os.environ.get("HYPERION_HOST_COORD", "1") != "0":
+    # later turns into a CoordError instead of a hung collective.
+    # Requires an explicit coordinator address: guessing 127.0.0.1 on a
+    # pod launch that relies on jax.distributed auto-detection would
+    # make every non-zero rank dial its own localhost and hang.
+    want_host_coord = os.environ.get("HYPERION_HOST_COORD", "1") != "0"
+    if _HOST_COORD is None and want_host_coord and coordinator_address:
         from hyperion_tpu.runtime.native_coord import DEFAULT_PORT, HostCoordinator
 
-        host = (coordinator_address or "127.0.0.1").split(":")[0]
+        host = coordinator_address.split(":")[0]
         port = int(os.environ.get("HYPERION_COORD_PORT", DEFAULT_PORT))
         _HOST_COORD = HostCoordinator(
             rank=process_id, world=num_processes, host=host, port=port,
@@ -93,9 +98,13 @@ def setup(
         _HOST_RANK = process_id
         log.info("host coordinator up (rank %d/%d via %s)",
                  process_id, num_processes, host)
+    elif want_host_coord and not coordinator_address:
+        log.info("no coordinator address configured; host-coordination "
+                 "layer disabled (jax.distributed auto-detection launch)")
 
     if os.environ.get("HYPERION_SKIP_JAX_INIT") == "1":
         _HOST_RANK = process_id
+        _JAX_SKIPPED = True
         _INITIALIZED = True
         return
 
@@ -118,21 +127,30 @@ def cleanup() -> None:
     """Tear down the runtime (reference `cleanup()`: barrier + destroy PG,
     distributed_utils.py:122-125). Barrier first so no process exits while
     a peer still has collectives in flight."""
-    global _INITIALIZED, _HOST_COORD, _HOST_RANK
-    if _INITIALIZED:
-        barrier("cleanup")
-        if jax.process_count() > 1:
+    global _INITIALIZED, _HOST_COORD, _HOST_RANK, _JAX_SKIPPED
+    try:
+        if _INITIALIZED:
+            barrier("cleanup")
+    finally:
+        # teardown must happen even when the barrier raises (dead peer):
+        # otherwise _INITIALIZED stays True, a later setup() no-ops on
+        # stale state, and rank 0's listening socket blocks a rebind
+        if _INITIALIZED and not _JAX_SKIPPED and jax.process_count() > 1:
             jax.distributed.shutdown()
         _INITIALIZED = False
-    if _HOST_COORD is not None:
-        _HOST_COORD.close()
-        _HOST_COORD = None
-        _HOST_RANK = None
+        _JAX_SKIPPED = False
+        if _HOST_COORD is not None:
+            _HOST_COORD.close()
+            _HOST_COORD = None
+            _HOST_RANK = None
 
 
 def process_index() -> int:
-    if jax.process_count() == 1 and _HOST_RANK is not None:
-        return _HOST_RANK  # host-coordination-only mode (pre-flight/tests)
+    if _HOST_RANK is not None:
+        # host-coordination-only mode (pre-flight/tests): answering from
+        # the coordinator avoids initializing the backend — the whole
+        # point is to run before chips are touched
+        return _HOST_RANK
     return jax.process_index()
 
 
@@ -169,8 +187,11 @@ def barrier(name: str = "barrier") -> None:
     distributed_utils.py:369,405). On a single process this is a
     device-flush, which preserves the 'everything before me finished'
     meaning for timing code. Multi-process: host-level barrier first
-    (fail-fast on dead peers), then the device-level sync."""
+    (fail-fast on dead peers), then the device-level sync. In
+    host-coordination-only mode no backend is ever initialized."""
     host_barrier(name)
+    if _JAX_SKIPPED:
+        return
     if jax.process_count() == 1:
         jax.effects_barrier()
         return

@@ -158,11 +158,14 @@ def _epoch_loop(
         if ckpt_dir:
             # named host barriers fence the IO the way the reference
             # bracketed FSDP checkpointing (distributed_utils.py:369,405)
-            # — and fail fast if a peer died mid-epoch
-            dist.host_barrier(f"pre_ckpt_{epoch}")
+            # — and fail fast if a peer died mid-epoch. Checkpoint IO
+            # duration legitimately skews across hosts (slow shared
+            # storage), so the timeout is generous — the reference
+            # raised its watchdog to 7200 s around exactly this IO.
+            dist.host_barrier(f"pre_ckpt_{epoch}", timeout_s=3600.0)
             ckpt.save(ckpt_dir, state, force=True)
             ckpt.prune(ckpt_dir, keep=2)  # full sharded state per epoch adds up
-            dist.host_barrier(f"post_ckpt_{epoch}")
+            dist.host_barrier(f"post_ckpt_{epoch}", timeout_s=3600.0)
     return state, history
 
 
@@ -173,6 +176,27 @@ def _lm_eval_cols(vm: list) -> dict:
         return {"val_loss": float("nan"), "val_ppl": float("nan")}
     vl = _mean_of(vm, "loss")
     return {"val_loss": vl, "val_ppl": float(np.exp(min(vl, 20.0)))}
+
+
+def _lm_validation(cfg: Config, splits, mesh, sharding, loss_fn,
+                   transform=None):
+    """(eval_step, val_batches, eval_cols, extra_schema) for LM-style
+    trainers; all-None/() when validation is off or the split is absent.
+    `transform` maps the TextSplit to arrays (e.g. Llama id clamping)."""
+    if not (cfg.train.validate and "validation" in splits):
+        return None, None, None, ()
+    arrays = (
+        transform(splits["validation"]) if transform
+        else splits["validation"].arrays()
+    )
+    val_batches = ShardedBatches(
+        arrays, cfg.train.batch_size, mesh, shuffle=False,
+        seed=cfg.train.seed,
+    )
+    eval_step = make_eval_step(
+        lambda p, bs, b: {"loss": loss_fn(p, bs, b, None)[0]}, sharding
+    )
+    return eval_step, val_batches, _lm_eval_cols, ("val_loss", "val_ppl")
 
 
 def _tier_impls(cfg: Config) -> dict[str, str]:
@@ -279,19 +303,9 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
         dropout=True,
     )
 
-    eval_step = val_batches = eval_cols = None
-    extra_schema: tuple = ()
-    if cfg.train.validate and "validation" in splits:
-        val_batches = ShardedBatches(
-            splits["validation"].arrays(), cfg.train.batch_size, mesh,
-            shuffle=False, seed=cfg.train.seed,
-        )
-        eval_step = make_eval_step(
-            lambda p, bs, b: {"loss": loss_fn(p, bs, b, None)[0]}, sharding
-        )
-
-        eval_cols = _lm_eval_cols
-        extra_schema = ("val_loss", "val_ppl")
+    eval_step, val_batches, eval_cols, extra_schema = _lm_validation(
+        cfg, splits, mesh, sharding, loss_fn
+    )
 
     logger, ckpt_dir, state, resume_epoch = _prepare_run(
         job, cfg, state, batches, n_dev, extra_schema
@@ -521,18 +535,9 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
         donate=cfg.optimization.donate_state,
     )
 
-    eval_step = val_batches = eval_cols = None
-    extra_schema: tuple = ()
-    if cfg.train.validate and "validation" in splits:
-        val_batches = ShardedBatches(
-            clamped(splits["validation"]), cfg.train.batch_size, mesh,
-            shuffle=False, seed=cfg.train.seed,
-        )
-        eval_step = make_eval_step(
-            lambda p, bs, b: {"loss": loss_fn(p, bs, b, None)[0]}, sharding
-        )
-        eval_cols = _lm_eval_cols
-        extra_schema = ("val_loss", "val_ppl")
+    eval_step, val_batches, eval_cols, extra_schema = _lm_validation(
+        cfg, splits, mesh, sharding, loss_fn, transform=clamped
+    )
 
     logger, ckpt_dir, state, resume_epoch = _prepare_run(
         job, cfg, state, batches, n_dev, extra_schema

@@ -110,6 +110,7 @@ class TestTransformerLM:
 
 
 class TestResNet:
+    @pytest.mark.slow
     def test_resnet18_cifar(self):
         model = resnet18(num_classes=10)
         variables = model.init_variables(jax.random.key(0))

@@ -85,6 +85,7 @@ class TestRematMemory:
         txt = compiled.as_text()
         return txt.count(" dot(") + txt.count(" dot.")
 
+    @pytest.mark.slow
     def test_full_remat_recomputes_matmuls_in_backward(self):
         plain = self._compiled(False)
         full = self._compiled("full")
@@ -93,6 +94,7 @@ class TestRematMemory:
         assert (full.memory_analysis().temp_size_in_bytes
                 <= 1.05 * plain.memory_analysis().temp_size_in_bytes)
 
+    @pytest.mark.slow
     def test_dots_policy_saves_matmul_outputs(self):
         plain = self._compiled(False)
         dots = self._compiled("dots")

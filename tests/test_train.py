@@ -106,6 +106,7 @@ class TestTrainStep:
 
 
 class TestTrainerDrivers:
+    @pytest.mark.slow
     def test_language_trainer_end_to_end(self, tmp_path, mesh_dp, monkeypatch):
         from hyperion_tpu.train.trainer import train_language_model
 
@@ -125,6 +126,7 @@ class TestTrainerDrivers:
         assert len(rows) == 3
         assert (tmp_path / "checkpoints" / "language_ddp_final.npz").exists()
 
+    @pytest.mark.slow
     def test_language_trainer_resumes(self, tmp_path, mesh_dp):
         from hyperion_tpu.train.trainer import train_language_model
 
@@ -141,6 +143,7 @@ class TestTrainerDrivers:
         assert len(res2.history) == 1  # only the one remaining epoch ran
         assert res2.history[0].epoch == 2
 
+    @pytest.mark.slow
     def test_cifar_trainer_end_to_end(self, tmp_path, mesh_dp):
         from hyperion_tpu.train.trainer import train_cifar_model
 
