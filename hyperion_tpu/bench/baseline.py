@@ -204,6 +204,10 @@ def main(argv=None) -> None:
     p.add_argument("--out", default="results/benchmarks/baseline")
     args = p.parse_args(argv)
 
+    from hyperion_tpu.metrics.plots import (
+        plot_baseline_models, plot_batch_scaling, try_plot,
+    )
+
     out = Path(args.out)
     rows = []
     for name in args.models:
@@ -211,13 +215,19 @@ def main(argv=None) -> None:
         rows.append(r)
         print(f"[baseline] {json.dumps(r)}")
     _write_csv(out / "model_benchmarks.csv", rows)
+    try_plot(plot_baseline_models, rows, out / "model_benchmarks.png")
 
     if args.scaling:
+        sweeps = {}
         for name in args.models:
             sweep = batch_size_scaling(name, args.batch_sizes, args.dtype)
             _write_csv(out / f"{name}_batch_scaling.csv", sweep)
+            sweeps[name] = sweep
             for r in sweep:
                 print(f"[baseline] scaling {json.dumps(r)}")
+        try_plot(plot_batch_scaling,
+                 {k: v for k, v in sweeps.items() if v},
+                 out / "batch_scaling.png")
     print(f"[baseline] results in {out}/")
 
 

@@ -260,6 +260,9 @@ def main(argv=None) -> None:
         w.writeheader()
         w.writerows(rows)
     (out / "compilation_benchmark.json").write_text(json.dumps(rows, indent=2))
+    from hyperion_tpu.metrics.plots import plot_compile_tiers, try_plot
+
+    try_plot(plot_compile_tiers, rows, out / "compilation_benchmark.png")
     text = summarize(rows)
     (out / "compilation_analysis.txt").write_text(text)
     print(text)

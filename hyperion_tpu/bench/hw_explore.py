@@ -28,6 +28,7 @@ from pathlib import Path
 import jax
 import jax.numpy as jnp
 
+from hyperion_tpu.metrics.plots import plot_bandwidth, plot_matmul_tflops, try_plot
 from hyperion_tpu.utils.chips import mfu as chip_mfu
 from hyperion_tpu.utils.chips import nominal_peak_tflops
 from hyperion_tpu.utils.memory import device_memory_stats
@@ -154,6 +155,7 @@ def main(argv=None) -> None:
               f"{r['tflops']:8.2f} TFLOPS ({r['time_ms']:.3f} ms)")
     out = Path(args.out)
     _write_csv(out / "precision_results.csv", rows)
+    try_plot(plot_matmul_tflops, rows, out / "precision_results.png")
 
     if not args.skip_bandwidth:
         bw = memory_bandwidth(args.bandwidth_elems, args.iters)
@@ -161,6 +163,7 @@ def main(argv=None) -> None:
             print(f"[hw_explore] bandwidth {r['elements']:>11,} elems: "
                   f"{r['gb_per_s']:8.2f} GB/s")
         _write_csv(out / "bandwidth_results.csv", bw)
+        try_plot(plot_bandwidth, bw, out / "bandwidth_results.png")
 
     (out / "device_info.json").write_text(json.dumps(info, indent=2))
     print(f"[hw_explore] results in {out}/")

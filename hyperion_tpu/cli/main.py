@@ -56,6 +56,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-validate", action="store_true",
                    help="skip the per-epoch validation pass")
+    p.add_argument("--profile-dir", default="",
+                   help="capture a jax.profiler trace of the first epoch "
+                        "into this directory (TensorBoard/XProf format)")
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--grad_accum", type=int, default=1)
     p.add_argument("--remat",
@@ -95,6 +98,7 @@ def make_config(args, job: str) -> Config:
     cfg.train.weight_decay = d.get("weight_decay", 0.0)
     cfg.train.steps_per_epoch = args.steps_per_epoch
     cfg.train.validate = not args.no_validate
+    cfg.train.profile_dir = args.profile_dir
     cfg.train.seed = args.seed
     cfg.train.lora = args.lora
     cfg.train.model = "llama_tiny" if args.llama_size == "tiny" else "llama_7b"

@@ -82,6 +82,7 @@ class TrainConfig:
     seq_len: int = 128               # reference tokenization window
     steps_per_epoch: int = 0         # 0 = full pass; >0 caps steps (smoke/bench runs)
     validate: bool = True            # per-epoch val pass (exceeds reference)
+    profile_dir: str = ""            # jax.profiler trace of epoch 1 (off when empty)
     seed: int = 0
     base_dir: str = "data"
     log_every: int = 50

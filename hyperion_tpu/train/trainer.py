@@ -51,6 +51,7 @@ from hyperion_tpu.runtime.mesh import make_mesh
 from hyperion_tpu.train.losses import classification_loss, next_token_loss
 from hyperion_tpu.train.state import create_train_state, make_optimizer
 from hyperion_tpu.train.step import make_eval_step, make_train_step
+from hyperion_tpu.utils import profiling
 from hyperion_tpu.utils.timing import host_fence
 
 
@@ -104,21 +105,28 @@ def _epoch_loop(
     fence_every_step = jax.default_backend() == "cpu"
     max_steps = cfg.train.steps_per_epoch or None
     for epoch in range(resume_epoch, cfg.train.epochs):
-        t0 = time.perf_counter()
-        device_metrics = []
-        for i, batch in enumerate(batches.epoch(epoch)):
-            if max_steps and i >= max_steps:
-                break
-            state, metrics = train_step(state, batch, rng)
-            device_metrics.append(metrics)  # stays on device until epoch end
-            if fence_every_step:
-                jax.block_until_ready(metrics)
-        # host-fetch fence: on the axon backend block_until_ready can
-        # return before execution, so fetch a scalar of the last step's
-        # metrics (which depends, through the state chain, on every step
-        # of the epoch) before stopping the timer
-        host_fence(device_metrics[-1])
-        duration = time.perf_counter() - t0  # train-only time; val follows
+        # --profile-dir: capture a jax.profiler trace of the FIRST epoch
+        # this run executes (SURVEY §5.1's idiomatic upgrade)
+        profile_this = cfg.train.profile_dir and epoch == resume_epoch
+        with profiling.capture(
+            cfg.train.profile_dir if profile_this else None
+        ):
+            t0 = time.perf_counter()
+            device_metrics = []
+            for i, batch in enumerate(batches.epoch(epoch)):
+                if max_steps and i >= max_steps:
+                    break
+                state, metrics = train_step(state, batch, rng)
+                device_metrics.append(metrics)  # stays on device until epoch end
+                if fence_every_step:
+                    jax.block_until_ready(metrics)
+            # host-fetch fence: on the axon backend block_until_ready can
+            # return before execution, so fetch a scalar of the last
+            # step's metrics (which depends, through the state chain, on
+            # every step of the epoch) before stopping the timer — and
+            # before the profiler capture closes, so traces are complete
+            host_fence(device_metrics[-1])
+            duration = time.perf_counter() - t0  # train-only; val follows
         loss = _mean_of(device_metrics, "loss")
         extra = extra_cols(device_metrics) if extra_cols else {}
         if eval_step is not None and eval_batches is not None:

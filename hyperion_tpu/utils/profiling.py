@@ -1,0 +1,39 @@
+"""Profiler trace capture — the idiomatic upgrade over wall-clock timers.
+
+Reference profiling is wall-clock brackets + memory counters only
+(SURVEY §5.1: `benchmarking.py:37-49`, memory probes throughout; no
+torch.profiler/rocprof integration anywhere). The TPU-native upgrade is
+`jax.profiler` trace capture: XLA emits per-op device timelines viewable
+in TensorBoard/XProf, which is how real TPU perf work is done.
+
+`capture()` wraps any code region; trainers expose it via
+`--profile-dir` so one flag turns a training epoch into a trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from pathlib import Path
+
+import jax
+
+
+@contextlib.contextmanager
+def capture(trace_dir: str | Path | None):
+    """Context manager: profile the enclosed region into `trace_dir`
+    (TensorBoard/XProf format). None = no-op, so call sites can pass the
+    config value straight through."""
+    if not trace_dir:
+        yield None
+        return
+    trace_dir = Path(trace_dir)
+    trace_dir.mkdir(parents=True, exist_ok=True)
+    with jax.profiler.trace(str(trace_dir)):
+        yield trace_dir
+    print(f"[profiling] trace written to {trace_dir} "
+          f"(view: tensorboard --logdir {trace_dir})")
+
+
+def annotate(name: str):
+    """Named sub-region inside a capture (shows as a span in the trace)."""
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,86 @@
+"""Plot layer + profiler capture (SURVEY §5.1 / reference PNG artifacts)."""
+
+from pathlib import Path
+
+import pytest
+
+from hyperion_tpu.metrics.plots import (
+    plot_bandwidth,
+    plot_baseline_models,
+    plot_batch_scaling,
+    plot_compile_tiers,
+    plot_matmul_tflops,
+    try_plot,
+)
+
+
+class TestPlots:
+    def test_compile_tiers(self, tmp_path):
+        rows = [
+            {"model": "lm", "variant": "op_by_op", "median_ms": 100.0},
+            {"model": "lm", "variant": "jit", "median_ms": 10.0},
+            {"model": "lm", "variant": "jit_pallas", "median_ms": 8.0},
+            {"model": "rn", "variant": "jit", "median_ms": 5.0},
+            {"model": "rn", "variant": "jit_pallas", "median_ms": float("nan")},
+        ]
+        p = plot_compile_tiers(rows, tmp_path / "c.png")
+        assert p.exists() and p.stat().st_size > 1000
+
+    def test_matmul_and_bandwidth(self, tmp_path):
+        rows = [
+            {"size": 1024, "dtype": "bfloat16", "tflops": 50.0,
+             "peak_tflops": 197.0},
+            {"size": 8192, "dtype": "bfloat16", "tflops": 172.0,
+             "peak_tflops": 197.0},
+            {"size": 8192, "dtype": "float32", "tflops": 30.0,
+             "peak_tflops": 197.0},
+        ]
+        assert plot_matmul_tflops(rows, tmp_path / "m.png").exists()
+        bw = [
+            {"elements": 10_000_000, "gb_per_s": 7000.0,
+             "note": "cache_resident_not_hbm"},
+            {"elements": 100_000_000, "gb_per_s": 690.0, "note": ""},
+            {"elements": 500_000_000, "gb_per_s": 683.0, "note": ""},
+        ]
+        assert plot_bandwidth(bw, tmp_path / "b.png").exists()
+
+    def test_baseline_panels(self, tmp_path):
+        rows = [
+            {"model": "resnet50", "forward_ms": 10, "backward_ms": 20,
+             "optimizer_ms": 2, "peak_memory_mb": 3000, "samples_per_s": 500},
+            {"model": "vit_b16", "forward_ms": 2, "backward_ms": 3,
+             "optimizer_ms": 0.5, "peak_memory_mb": 500, "samples_per_s": 5000},
+        ]
+        assert plot_baseline_models(rows, tmp_path / "bl.png").exists()
+        sweeps = {"resnet50": [
+            {"batch_size": 1, "samples_per_s": 40, "peak_memory_mb": 600},
+            {"batch_size": 32, "samples_per_s": 550, "peak_memory_mb": 3200},
+        ]}
+        assert plot_batch_scaling(sweeps, tmp_path / "sc.png").exists()
+
+    def test_try_plot_swallows_errors(self, capsys):
+        assert try_plot(plot_compile_tiers, None, "/nonexistent/x.png") is None
+        assert "skipped" in capsys.readouterr().out
+
+
+class TestProfiling:
+    def test_capture_writes_trace(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from hyperion_tpu.utils import profiling
+
+        with profiling.capture(tmp_path / "trace"):
+            with profiling.annotate("matmul_region"):
+                x = jnp.ones((64, 64))
+                jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+        files = list(Path(tmp_path / "trace").rglob("*"))
+        assert any(f.is_file() for f in files), files
+
+    def test_capture_none_is_noop(self):
+        from hyperion_tpu.utils import profiling
+
+        with profiling.capture(None) as d:
+            assert d is None
+        with profiling.capture("") as d:
+            assert d is None
