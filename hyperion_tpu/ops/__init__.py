@@ -7,3 +7,7 @@ Pallas TPU kernels as the tuned tier (`compile_tier="jit+pallas"`).
 """
 
 from hyperion_tpu.ops.attention import dot_product_attention  # noqa: F401
+# seq_sharding rides along because the function re-export shadows the
+# ring_attention submodule path
+from hyperion_tpu.ops.ring_attention import ring_attention, seq_sharding  # noqa: F401
+from hyperion_tpu.ops.ulysses import ulysses_attention  # noqa: F401
