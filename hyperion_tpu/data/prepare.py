@@ -128,6 +128,33 @@ def prepare(
     return out
 
 
+def prepare_cifar(base_dir: str | Path = "data", verbose: bool = True) -> None:
+    """C19's vision pipeline as a command: read CIFAR-10 pickle batches
+    (or the synthetic fallback), normalize + validity-filter, serialize
+    to native recordio, reload-verify."""
+    from hyperion_tpu.data.vision import (
+        load_cifar10_source, load_recordio_splits, save_recordio,
+    )
+
+    base = Path(base_dir)
+    # read the SOURCE (pickles or synthetic), never prior prepared
+    # output — fresh pickles must always win over stale recordio
+    splits = load_cifar10_source(base)
+    out = base / "cifar10_prepared"
+    save_recordio(splits, out)
+    reloaded = load_recordio_splits(out)
+    for name, s in splits.items():
+        r = reloaded[name]
+        np.testing.assert_array_equal(r.images, s.images)
+        np.testing.assert_array_equal(r.labels, s.labels)
+        r.verify()
+        if verbose:
+            print(f"[prepare] cifar {name}: {len(s)} images "
+                  f"(src {s.source}) -> {out}/{name}.*.rio")
+    if verbose:
+        print(f"[prepare] cifar reload-verify OK ({', '.join(splits)})")
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--raw-dir", default=None,
@@ -135,6 +162,8 @@ def main(argv=None) -> None:
     p.add_argument("--input", default=None, help="single raw text file")
     p.add_argument("--split-name", default="train",
                    help="split name for --input")
+    p.add_argument("--cifar", action="store_true",
+                   help="prepare the CIFAR-10 pipeline instead of text")
     p.add_argument("--base-dir", default="data")
     p.add_argument("--seq-len", type=int, default=DEFAULT_SEQ_LEN)
     p.add_argument("--tokenizer-dir", default=None,
@@ -142,6 +171,10 @@ def main(argv=None) -> None:
                         "(default {base}/tokenizer)")
     p.add_argument("--vocab-size", type=int, default=8192)
     args = p.parse_args(argv)
+
+    if args.cifar:
+        prepare_cifar(args.base_dir)
+        return
 
     raw: dict[str, list[str]] = {}
     if args.raw_dir:
@@ -153,7 +186,8 @@ def main(argv=None) -> None:
         raw[args.split_name] = Path(args.input).read_text(
             encoding="utf-8").splitlines()
     if not raw:
-        raise SystemExit("nothing to prepare: pass --raw-dir or --input")
+        raise SystemExit("nothing to prepare: pass --raw-dir, --input, "
+                         "or --cifar")
 
     prepare(raw, args.base_dir, args.seq_len, args.tokenizer_dir,
             args.vocab_size)

@@ -185,7 +185,8 @@ def load_wikitext2(
         if (base / f"{split}.ids.rio").exists():
             try:  # half-written prepare output falls through, like every
                 s = load_recordio_split(base, split)  # other source
-            except OSError as e:
+            except (OSError, ValueError, KeyError) as e:
+                # ValueError/KeyError: truncated or field-less JSON sidecar
                 print(f"[load_wikitext2] recordio {split} unreadable "
                       f"({e}); falling back")
         if s is not None:

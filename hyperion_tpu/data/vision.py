@@ -115,13 +115,43 @@ def synthetic_cifar_split(n: int, seed: int = 0) -> VisionSplit:
     return VisionSplit(np.clip(images, -1, 1).astype(np.float32), labels)
 
 
-def load_cifar10(
+def save_recordio(splits: dict[str, VisionSplit], out_dir: str | Path) -> None:
+    """Serialize splits as native recordio (the torch.save-tuple-list
+    analogue, cell 5:40-48, on the framework's own store)."""
+    from hyperion_tpu.data.recordio import write_records
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    for name, s in splits.items():
+        write_records(out / f"{name}.images.rio", s.images)
+        write_records(out / f"{name}.labels.rio", s.labels.reshape(-1, 1))
+
+
+def load_recordio_splits(rec_dir: str | Path) -> dict[str, VisionSplit]:
+    from hyperion_tpu.data.recordio import RecordFile
+
+    rec_dir = Path(rec_dir)
+    out = {}
+    for f in sorted(rec_dir.glob("*.images.rio")):
+        name = f.name.removesuffix(".images.rio")
+        with RecordFile(f) as imgs, \
+             RecordFile(rec_dir / f"{name}.labels.rio") as labels:
+            out[name] = VisionSplit(
+                imgs.read_all(), labels.read_all().reshape(-1),
+                source=f"recordio:{rec_dir / name}",
+            )
+    return out
+
+
+def load_cifar10_source(
     base_dir: str | Path = "data",
     synthetic_sizes: dict[str, int] | None = None,
     seed: int = 0,
 ) -> dict[str, VisionSplit]:
-    """Load CIFAR-10, preferring `{base}/cifar-10-batches-py`, falling
-    back to synthetic (default sizes 50000/10000 scaled down 10x)."""
+    """The *source* data only — pickle batches if present, else
+    synthetic. `prepare --cifar` must read this, never its own prior
+    recordio output (or stale prepared data would shadow freshly
+    downloaded pickles forever)."""
     d = Path(base_dir) / "cifar-10-batches-py"
     if d.is_dir() and (d / "data_batch_1").exists():
         out = load_cifar_batches(d)
@@ -136,3 +166,27 @@ def load_cifar10(
     for s in out.values():
         s.verify()
     return out
+
+
+def load_cifar10(
+    base_dir: str | Path = "data",
+    synthetic_sizes: dict[str, int] | None = None,
+    seed: int = 0,
+) -> dict[str, VisionSplit]:
+    """Load CIFAR-10. Search order: `{base}/cifar10_prepared` (native
+    recordio, from `data.prepare --cifar`), `{base}/cifar-10-batches-py`
+    (standard pickles), synthetic (default sizes 50000/10000 scaled
+    down 10x)."""
+    rec = Path(base_dir) / "cifar10_prepared"
+    if rec.is_dir() and list(rec.glob("*.images.rio")):
+        try:
+            out = load_recordio_splits(rec)
+            for s in out.values():
+                s.verify()
+            return out
+        # OSError: missing/short files; ValueError covers a truncated
+        # JSON sidecar (JSONDecodeError); KeyError a sidecar missing
+        # fields — all mean "half-written prepare output, fall through"
+        except (OSError, ValueError, KeyError) as e:
+            print(f"[load_cifar10] recordio unreadable ({e}); falling back")
+    return load_cifar10_source(base_dir, synthetic_sizes, seed)

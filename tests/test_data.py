@@ -161,3 +161,17 @@ class TestShardedBatches:
         s = synthetic_lm_split(8, seq_len=4)
         with pytest.raises(ValueError, match="global_batch"):
             ShardedBatches(s.arrays(), 16, mesh8)
+
+
+class TestPrepareCifar:
+    def test_cifar_prepare_roundtrip(self, tmp_path):
+        from hyperion_tpu.data.prepare import prepare_cifar
+        from hyperion_tpu.data.vision import load_cifar10
+
+        prepare_cifar(tmp_path, verbose=False)
+        assert (tmp_path / "cifar10_prepared" / "train.images.rio").exists()
+        # loader must now prefer the recordio output
+        splits = load_cifar10(tmp_path, synthetic_sizes={"train": 64})
+        assert splits["train"].source.startswith("recordio")
+        assert len(splits["train"]) == 5000  # the prepared (full) split
+        splits["train"].verify()
