@@ -229,9 +229,14 @@ def summarize(rows: list[dict]) -> str:
                 lines.append(f"  {variant:>10}: failed")
                 continue
             speed = (base / r["median_ms"]) if base else float("nan")
+            mem = r.get("temp_memory_gb")
+            mem_s = (
+                f"  temp {mem:.3f} GB"
+                if isinstance(mem, (int, float)) and mem == mem else ""
+            )
             lines.append(
                 f"  {variant:>10}: {r['median_ms']:9.3f} ms"
-                f"  ({speed:.2f}x vs jit) {r['note']}"
+                f"  ({speed:.2f}x vs jit){mem_s} {r['note']}"
             )
     return "\n".join(lines)
 

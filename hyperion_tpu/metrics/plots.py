@@ -51,7 +51,7 @@ def plot_compile_tiers(rows: list[dict], out_path: str | Path) -> Path | None:
     order = {"op_by_op": 0, "jit": 1, "jit_pallas": 2}
     variants = sorted({r["variant"] for r in rows},
                       key=lambda v: order.get(v, 99))
-    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(13, 5))
+    fig, (ax1, ax2, ax3) = plt.subplots(1, 3, figsize=(18, 5))
 
     width = 0.8 / max(len(variants), 1)
     for vi, variant in enumerate(variants):
@@ -83,6 +83,27 @@ def plot_compile_tiers(rows: list[dict], out_path: str | Path) -> Path | None:
     ax2.set_xticklabels(models, rotation=20, ha="right", fontsize=8)
     ax2.set_ylabel("speedup of jit+pallas over jit (x)")
     ax2.set_title("pallas-kernel speedup")
+
+    # the reference's plot_mem analogue: per-program temp memory
+    for vi, variant in enumerate(variants):
+        if variant == "op_by_op":
+            continue  # no single compiled program to analyse
+        xs, ys = [], []
+        offset = (vi - (len(variants) - 1) / 2) * width
+        for mi, m in enumerate(models):
+            sub = [r for r in rows
+                   if r["model"] == m and r["variant"] == variant]
+            vals = _finite(sub, "temp_memory_gb")
+            if vals:
+                xs.append(mi + offset)
+                ys.append(vals[0][1])
+        if xs:
+            ax3.bar(xs, ys, width, label=variant)
+    ax3.set_xticks(range(len(models)))
+    ax3.set_xticklabels(models, rotation=20, ha="right", fontsize=8)
+    ax3.set_ylabel("compiled temp memory (GB)")
+    ax3.set_title("per-program temp memory")
+    ax3.legend()
 
     fig.tight_layout()
     out_path = Path(out_path)
