@@ -71,9 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default="jit",
                    help="jit+pallas swaps in the in-tree flash-attention "
                         "and fused-norm kernels (max-autotune analogue)")
-    p.add_argument("--attention-impl", choices=["xla", "pallas"], default=None,
+    p.add_argument("--attention-impl",
+                   choices=["xla", "pallas", "ring", "ulysses"], default=None,
                    help="override just the attention kernel, leaving norms "
-                        "on the tier default")
+                        "on the tier default; ring/ulysses = sequence "
+                        "parallelism over the mesh's seq axis")
     return p
 
 

@@ -37,6 +37,7 @@ class ShardedBatches:
         mesh: Mesh,
         shuffle: bool = True,
         seed: int = 0,
+        seq_shard: bool = False,
     ):
         lens = {k: v.shape[0] for k, v in arrays.items()}
         if len(set(lens.values())) != 1:
@@ -49,7 +50,26 @@ class ShardedBatches:
         self.mesh = mesh
         self.shuffle = shuffle
         self.seed = seed
-        self.sharding: NamedSharding = batch_sharding(mesh)
+        if seq_shard:
+            # sequence-parallel runs: dim 1 ([B, T] token arrays) lives
+            # on the seq axis so ring/ulysses shard_maps see their
+            # expected layout without an all-to-one reshard
+            from hyperion_tpu.runtime.mesh import AxisName
+            from jax.sharding import PartitionSpec as P
+
+            n_seq = mesh.shape[AxisName.SEQ]
+            for name, v in arrays.items():
+                if v.ndim < 2 or v.shape[1] % n_seq:
+                    raise ValueError(
+                        f"seq_shard: array {name!r} dim 1 "
+                        f"({v.shape[1:] or 'scalar rows'}) must divide the "
+                        f"seq axis ({n_seq}); pick seq_len divisible by it"
+                    )
+            self.sharding = NamedSharding(
+                mesh, P(AxisName.BATCH, AxisName.SEQ)
+            )
+        else:
+            self.sharding = batch_sharding(mesh)
         n_shards = int(np.prod([mesh.shape[a] for a in self.sharding.spec[0]]))
         if global_batch % n_shards:
             raise ValueError(
@@ -81,7 +101,9 @@ class ShardedBatches:
         return jax.make_array_from_callback(
             global_shape,
             self.sharding,
-            lambda i: np.ascontiguousarray(v[idx[i[0]]]),
+            # i is one slice per dim; dim 0 routes through the epoch
+            # permutation, trailing dims (e.g. seq shards) slice directly
+            lambda i: np.ascontiguousarray(v[idx[i[0]]][(slice(None),) + i[1:]]),
         )
 
     def __len__(self) -> int:

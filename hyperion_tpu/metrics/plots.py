@@ -53,24 +53,31 @@ def plot_compile_tiers(rows: list[dict], out_path: str | Path) -> Path | None:
                       key=lambda v: order.get(v, 99))
     fig, (ax1, ax2, ax3) = plt.subplots(1, 3, figsize=(18, 5))
 
-    width = 0.8 / max(len(variants), 1)
-    for vi, variant in enumerate(variants):
-        xs, ys = [], []
-        offset = (vi - (len(variants) - 1) / 2) * width
-        for mi, m in enumerate(models):
-            sub = [r for r in rows if r["model"] == m and r["variant"] == variant]
-            vals = _finite(sub, "median_ms")
-            if vals:
-                xs.append(mi + offset)
-                ys.append(vals[0][1])
-        if xs:
-            ax1.bar(xs, ys, width, label=variant)
-    ax1.set_xticks(range(len(models)))
-    ax1.set_xticklabels(models, rotation=20, ha="right", fontsize=8)
+    def grouped_bars(ax, variants, key):
+        width = 0.8 / max(len(variants), 1)
+        any_bar = False
+        for vi, variant in enumerate(variants):
+            xs, ys = [], []
+            offset = (vi - (len(variants) - 1) / 2) * width
+            for mi, m in enumerate(models):
+                sub = [r for r in rows
+                       if r["model"] == m and r["variant"] == variant]
+                vals = _finite(sub, key)
+                if vals:
+                    xs.append(mi + offset)
+                    ys.append(vals[0][1])
+            if xs:
+                ax.bar(xs, ys, width, label=variant)
+                any_bar = True
+        ax.set_xticks(range(len(models)))
+        ax.set_xticklabels(models, rotation=20, ha="right", fontsize=8)
+        if any_bar:
+            ax.legend()
+
+    grouped_bars(ax1, variants, "median_ms")
     ax1.set_ylabel("latency (ms)")
     ax1.set_yscale("log")
     ax1.set_title("compilation tiers: latency")
-    ax1.legend()
 
     for mi, m in enumerate(models):
         sub = {r["variant"]: r for r in rows if r["model"] == m}
@@ -85,25 +92,11 @@ def plot_compile_tiers(rows: list[dict], out_path: str | Path) -> Path | None:
     ax2.set_title("pallas-kernel speedup")
 
     # the reference's plot_mem analogue: per-program temp memory
-    for vi, variant in enumerate(variants):
-        if variant == "op_by_op":
-            continue  # no single compiled program to analyse
-        xs, ys = [], []
-        offset = (vi - (len(variants) - 1) / 2) * width
-        for mi, m in enumerate(models):
-            sub = [r for r in rows
-                   if r["model"] == m and r["variant"] == variant]
-            vals = _finite(sub, "temp_memory_gb")
-            if vals:
-                xs.append(mi + offset)
-                ys.append(vals[0][1])
-        if xs:
-            ax3.bar(xs, ys, width, label=variant)
-    ax3.set_xticks(range(len(models)))
-    ax3.set_xticklabels(models, rotation=20, ha="right", fontsize=8)
+    # (op_by_op has no single compiled program, so it has no bar)
+    grouped_bars(ax3, [v for v in variants if v != "op_by_op"],
+                 "temp_memory_gb")
     ax3.set_ylabel("compiled temp memory (GB)")
     ax3.set_title("per-program temp memory")
-    ax3.legend()
 
     fig.tight_layout()
     out_path = Path(out_path)

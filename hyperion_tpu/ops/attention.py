@@ -10,9 +10,12 @@ is in-tree with selectable implementations:
   impl="pallas"  in-tree flash-attention Pallas kernel
                  (hyperion_tpu.ops.pallas.flash_attention) — the
                  Inductor/Triton "max-autotune" analogue.
+  impl="ring"    sequence-parallel ring attention over the active
+  impl="ulysses" mesh's seq axis (ops.ring_attention / ops.ulysses) —
+                 a model config string turns on context parallelism.
 
 Shapes follow the TPU-friendly [batch, seq, heads, head_dim] layout so
-the seq axis can later be sharded for ring attention (SURVEY §5.7).
+the seq axis shards directly for the sequence-parallel impls.
 """
 
 from __future__ import annotations
@@ -81,6 +84,31 @@ def dot_product_attention(
                 "the pallas attention tier is not built yet; use impl='xla'"
             ) from e
         return flash_attention(q, k, v, causal=causal, padding_mask=padding_mask)
+    # "ulysses:pallas" etc. — sequence-parallel strategy plus the local
+    # kernel it should run per shard (ulysses' full-sequence local
+    # attention can use the flash kernel; ring has its own inner loop)
+    strategy, _, local_impl = impl.partition(":")
+    if strategy in ("ring", "ulysses"):
+        from hyperion_tpu.runtime.mesh import active_mesh
+
+        mesh = active_mesh()
+        if mesh is None:
+            raise ValueError(
+                f"impl={impl!r} needs an active mesh — trainers register "
+                "theirs via runtime.mesh.set_active_mesh before tracing"
+            )
+        if strategy == "ring":
+            from hyperion_tpu.ops.ring_attention import ring_attention
+
+            return ring_attention(
+                q, k, v, mesh, causal=causal, padding_mask=padding_mask
+            )
+        from hyperion_tpu.ops.ulysses import ulysses_attention
+
+        return ulysses_attention(
+            q, k, v, mesh, causal=causal, padding_mask=padding_mask,
+            impl=local_impl or "xla",
+        )
     if impl != "xla":
         raise ValueError(f"unknown attention impl {impl!r}")
 

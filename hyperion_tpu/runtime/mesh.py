@@ -99,6 +99,43 @@ def make_mesh(
     return Mesh(arr, AxisName.ALL, axis_types=auto)
 
 
+# --- active mesh -------------------------------------------------------
+# Model code is deliberately mesh-agnostic, but the sequence-parallel
+# attention impls (ring/ulysses) are shard_maps that need the Mesh
+# object. The TRAINING mesh is registered explicitly (trainers do it
+# right after building theirs; make_mesh deliberately does not — a bench
+# sweep building a side mesh must never silently rebind a live model's
+# attention); ops.attention reads it when impl is "ring"/"ulysses" so a
+# model config string is enough to turn on sequence parallelism.
+
+_ACTIVE_MESH: Mesh | None = None
+
+
+def set_active_mesh(mesh: Mesh | None) -> None:
+    global _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+
+
+def active_mesh() -> Mesh | None:
+    return _ACTIVE_MESH
+
+
+class activate_mesh:
+    """Scoped registration: `with activate_mesh(mesh): ...` restores the
+    previous active mesh on exit (what tests and nested runs want)."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        self.prev = active_mesh()
+        set_active_mesh(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        set_active_mesh(self.prev)
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for a [batch, ...] array: batch split over (data, fsdp);
     trailing dims replicated (PartitionSpec leaves them unlisted).

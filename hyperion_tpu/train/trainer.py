@@ -199,7 +199,7 @@ def _lm_validation(cfg: Config, splits, mesh, sharding, loss_fn,
     )
     val_batches = ShardedBatches(
         arrays, cfg.train.batch_size, mesh, shuffle=False,
-        seed=cfg.train.seed,
+        seed=cfg.train.seed, seq_shard=mesh.shape["seq"] > 1,
     )
     eval_step = make_eval_step(
         lambda p, bs, b: {"loss": loss_fn(p, bs, b, None)[0]}, sharding
@@ -215,6 +215,8 @@ def _tier_impls(cfg: Config) -> dict[str, str]:
     pallas = cfg.optimization.compile_tier in ("jit+pallas", "pallas")
     impl = "pallas" if pallas else "xla"
     attn = cfg.optimization.attention_impl or impl
+    if attn == "ulysses" and pallas:
+        attn = "ulysses:pallas"  # flash kernel as the local attention
     return {"attention_impl": attn, "norm_impl": impl}
 
 
@@ -222,7 +224,13 @@ def _build_mesh(cfg: Config):
     devices = None
     if cfg.distributed.max_devices:
         devices = jax.devices()[: cfg.distributed.max_devices]
-    return make_mesh(cfg.distributed.mesh_spec(), devices=devices)
+    mesh = make_mesh(cfg.distributed.mesh_spec(), devices=devices)
+    # register the TRAINING mesh for the mesh-dependent attention impls
+    # (ring/ulysses); side meshes built elsewhere never rebind it
+    from hyperion_tpu.runtime.mesh import set_active_mesh
+
+    set_active_mesh(mesh)
+    return mesh
 
 
 def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int,
@@ -268,9 +276,10 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
     want = ("train", "validation") if cfg.train.validate else ("train",)
     splits = load_wikitext2(cfg.train.base_dir, splits=want,
                             seq_len=cfg.train.seq_len, seed=cfg.train.seed)
+    seq_shard = mesh.shape["seq"] > 1  # sequence-parallel run
     batches = ShardedBatches(
         splits["train"].arrays(), cfg.train.batch_size, mesh,
-        shuffle=True, seed=cfg.train.seed,
+        shuffle=True, seed=cfg.train.seed, seq_shard=seq_shard,
     )
 
     policy = get_policy(cfg.optimization.precision)
@@ -471,7 +480,7 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
 
     batches = ShardedBatches(
         clamped(splits["train"]), cfg.train.batch_size, mesh,
-        shuffle=True, seed=cfg.train.seed,
+        shuffle=True, seed=cfg.train.seed, seq_shard=mesh.shape["seq"] > 1,
     )
 
     lora_cfg = LoraConfig(rank=cfg.train.lora_rank, alpha=cfg.train.lora_alpha)

@@ -66,3 +66,27 @@ class TestRingAttention:
         q, k, v = qkv(shape=(2, 30, 2, 8))
         with pytest.raises(ValueError, match="not divisible"):
             ring_attention(q, k, v, seq_mesh, causal=True)
+
+
+class TestRingPadding:
+    def test_padding_mask_matches_reference(self, seq_mesh):
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ks = jax.random.split(jax.random.key(5), 3)
+        q, k, v = [jax.random.normal(kk, (2, 32, 2, 8), jnp.float32)
+                   for kk in ks]
+        mask_np = np.ones((2, 32), np.int8)
+        mask_np[0, 20:] = 0
+        mask_np[1, 28:] = 0
+        ref = dot_product_attention(q, k, v, causal=True,
+                                    padding_mask=jnp.asarray(mask_np))
+        sh = NamedSharding(seq_mesh, P("data", "seq"))
+        qs, ks_, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        pad = jax.device_put(jnp.asarray(mask_np), sh)
+        out = ring_attention(qs, ks_, vs, seq_mesh, causal=True,
+                             padding_mask=pad)
+        # compare only real-query rows (pad rows are don't-care)
+        o, r = np.asarray(out), np.asarray(ref)
+        np.testing.assert_allclose(o[0, :20], r[0, :20], atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(o[1, :28], r[1, :28], atol=2e-5, rtol=2e-5)

@@ -213,3 +213,25 @@ class TestLosses:
         loss, counts = classification_loss(logits, labels)
         assert float(counts["correct"]) == 2.0
         assert float(counts["total"]) == 3.0
+
+
+class TestSeqParallelTraining:
+    @pytest.mark.slow
+    def test_language_trainer_with_ring_attention(self, tmp_path, monkeypatch):
+        """End-to-end sequence-parallel training: mesh (data=2, seq=4),
+        batches seq-sharded, ring attention inside the train step."""
+        from hyperion_tpu.train.trainer import train_language_model
+
+        cfg = Config()
+        cfg.train.epochs = 1
+        cfg.train.batch_size = 16
+        cfg.train.seq_len = 32
+        cfg.train.steps_per_epoch = 4
+        cfg.train.base_dir = str(tmp_path)
+        cfg.train.learning_rate = 1e-2
+        cfg.train.validate = False
+        cfg.distributed.data = 2
+        cfg.distributed.seq = 4
+        cfg.optimization.attention_impl = "ring"
+        res = train_language_model(cfg)
+        assert np.isfinite(res.final_loss)
