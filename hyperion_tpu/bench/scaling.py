@@ -39,12 +39,16 @@ def run_scaling_experiment(
     base_dir: str = "data",
     steps_per_epoch: int = 20,
     simulate_on_cpu: bool | None = None,
+    batch_size: int | None = None,
+    validate: bool = True,
 ) -> list[dict]:
     """Run `model` at each device count in a fresh subprocess; report."""
-    n_real = len(jax.devices())
+    # Only probe the real backend when the caller did not decide: with
+    # simulate_on_cpu explicitly set, touching jax.devices() here would
+    # block the whole sweep on an unreachable TPU tunnel.
     if simulate_on_cpu is None:
-        simulate_on_cpu = n_real < 2  # single chip: simulate the mesh on CPU
-    limit = 8 if simulate_on_cpu else n_real
+        simulate_on_cpu = len(jax.devices()) < 2  # single chip: simulate on CPU
+    limit = 8 if simulate_on_cpu else len(jax.devices())
     device_counts = device_counts or _default_counts(limit)
 
     for n in device_counts:
@@ -54,6 +58,10 @@ def run_scaling_experiment(
             "--base_dir", base_dir, "--devices", str(n),
             "--steps-per-epoch", str(steps_per_epoch),
         ]
+        if batch_size:
+            cmd += ["--batch_size", str(batch_size)]
+        if not validate:
+            cmd += ["--no-validate"]
         env = dict(os.environ)
         if simulate_on_cpu:
             env["JAX_PLATFORMS"] = "cpu"

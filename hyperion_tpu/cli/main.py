@@ -53,6 +53,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="restrict to first N devices (scaling runs)")
     p.add_argument("--scaling_devices", type=int, nargs="*", default=None,
                    help="device counts for --model scaling (default 1,2,4,8 clipped)")
+    p.add_argument("--simulate-cpu", action="store_true",
+                   help="scaling: force the CPU-simulated mesh without "
+                        "probing real devices (never blocks on a dead "
+                        "TPU tunnel); default: auto-detect")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-validate", action="store_true",
                    help="skip the per-epoch validation pass")
@@ -153,6 +157,9 @@ def main(argv=None) -> int:
             epochs=args.epochs,
             base_dir=args.base_dir,
             steps_per_epoch=args.steps_per_epoch or 20,
+            simulate_on_cpu=True if args.simulate_cpu else None,
+            batch_size=args.batch_size,
+            validate=not args.no_validate,
         )
     else:
         jobs = (
@@ -162,7 +169,8 @@ def main(argv=None) -> int:
         for job in jobs:  # reference 'all' runs the four jobs sequentially
             run_job(args, job)
 
-    if dist.is_primary():
+    # scaling already reported from inside run_scaling_experiment
+    if args.model != "scaling" and dist.is_primary():
         create_scaling_report(f"{args.base_dir}/distributed")
     dist.cleanup()
     return 0

@@ -142,7 +142,37 @@ def cleanup() -> None:
         if _HOST_COORD is not None:
             _HOST_COORD.close()
             _HOST_COORD = None
-            _HOST_RANK = None
+        _HOST_RANK = None  # also set in skip-jax mode without a coordinator
+
+
+def _backends_ready() -> bool:
+    try:
+        from jax._src import xla_bridge
+
+        return xla_bridge.backends_are_initialized()
+    except Exception:  # API drift: fall back to asking jax directly
+        return True
+
+
+def _single_process() -> bool:
+    # Rank/count short-circuit, in three layers:
+    #   1. jax.distributed ran (through setup()): jax is authoritative.
+    #   2. The backend is already up: asking jax is free AND correct —
+    #      on a TPU pod slice libtpu knows the true host index even
+    #      without env vars, so the fall-through must win there.
+    #   3. Backend not yet initialized and the launch env declares one
+    #      process: the rank is 0 by construction. Asking jax here
+    #      would *initialize* the backend — and block forever on a
+    #      dead TPU tunnel — for an answer that is already known.
+    if _INITIALIZED or int(_env_first(_ENV_NUM_PROCESSES) or 1) > 1:
+        return False
+    # libtpu pod-worker env (set by Cloud TPU on every pod host) is
+    # multi-process evidence even with no RANK/WORLD_SIZE configured —
+    # there the backend must be consulted for the true host index
+    if any(os.environ.get(v) for v in
+           ("TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES", "MEGASCALE_SLICE_ID")):
+        return False
+    return not _backends_ready()
 
 
 def process_index() -> int:
@@ -151,10 +181,16 @@ def process_index() -> int:
         # the coordinator avoids initializing the backend — the whole
         # point is to run before chips are touched
         return _HOST_RANK
+    if _single_process():
+        return 0
     return jax.process_index()
 
 
 def process_count() -> int:
+    if _HOST_RANK is not None and _JAX_SKIPPED:
+        return int(_env_first(_ENV_NUM_PROCESSES) or 1)
+    if _single_process():
+        return 1
     return jax.process_count()
 
 

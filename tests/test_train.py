@@ -85,6 +85,7 @@ class TestTrainStep:
                          jax.tree.leaves(sharding.tree.params)):
             assert p.sharding.spec == sh.spec
 
+    @pytest.mark.slow
     def test_grad_accum_matches_full_batch(self, lm_setup, mesh8):
         model, opt, state, sharding, loss_fn = lm_setup
         batch = make_batch(mesh8)

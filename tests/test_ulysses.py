@@ -66,6 +66,7 @@ class TestUlysses:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_grads_match_full_attention(self, mesh_seq):
         q, k, v = qkv()
 
