@@ -47,8 +47,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--precision", choices=["fp32", "bf16", "bf16_full"],
                    default="bf16")
     p.add_argument("--mesh", default=None,
-                   help="axis sizes data,fsdp,model,seq (e.g. 2,4,1,1); "
-                        "default: all-data, or all-fsdp for *_fsdp jobs")
+                   help="axis sizes data,fsdp,model,seq[,pipe] (e.g. "
+                        "2,4,1,1 or 2,1,1,1,4); default: all-data, or "
+                        "all-fsdp for *_fsdp jobs")
+    p.add_argument("--pipe_microbatches", type=int, default=0,
+                   help="GPipe microbatches when the mesh has a pipe "
+                        "axis (0 = one per stage)")
     p.add_argument("--devices", type=int, default=0,
                    help="restrict to first N devices (scaling runs)")
     p.add_argument("--scaling_devices", type=int, nargs="*", default=None,
@@ -119,12 +123,15 @@ def make_config(args, job: str) -> Config:
     if job in ("language_fsdp", "llama"):
         cfg.optimization.grad_clip_norm = 1.0  # reference clip 1.0 (:351,522)
     cfg.distributed.max_devices = args.devices
+    cfg.distributed.pipe_microbatches = args.pipe_microbatches
     if args.mesh:
-        data, fsdp, model, seq = (int(x) for x in args.mesh.split(","))
-        cfg.distributed.data = data
-        cfg.distributed.fsdp = fsdp
-        cfg.distributed.model = model
-        cfg.distributed.seq = seq
+        sizes = [int(x) for x in args.mesh.split(",")]
+        if len(sizes) not in (4, 5):
+            raise SystemExit(
+                f"--mesh wants data,fsdp,model,seq[,pipe], got {args.mesh!r}"
+            )
+        for name, v in zip(("data", "fsdp", "model", "seq", "pipe"), sizes):
+            setattr(cfg.distributed, name, v)
     elif job in ("language_fsdp",) or (job == "llama" and not args.lora):
         cfg.distributed.data = 1
         cfg.distributed.fsdp = -1  # whole mesh on the fsdp axis

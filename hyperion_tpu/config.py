@@ -60,13 +60,16 @@ class DistributedConfig:
     fsdp: int = 1
     model: int = 1
     seq: int = 1
+    pipe: int = 1                    # pipeline stages (parallel.pipeline)
+    pipe_microbatches: int = 0       # 0 = same as pipe (GPipe M >= S)
     max_devices: int = 0  # 0 = all; >0 restricts the mesh to the first N
     coordinator_address: str | None = None
     num_processes: int | None = None
     process_id: int | None = None
 
     def mesh_spec(self) -> MeshSpec:
-        return MeshSpec(data=self.data, fsdp=self.fsdp, model=self.model, seq=self.seq)
+        return MeshSpec(data=self.data, fsdp=self.fsdp, model=self.model,
+                        seq=self.seq, pipe=self.pipe)
 
 
 @dataclasses.dataclass

@@ -38,3 +38,7 @@ from hyperion_tpu.models.lora import (  # noqa: F401
     merge_lora,
     trainable_fraction,
 )
+from hyperion_tpu.models.pipeline_lm import (  # noqa: F401
+    PipelinedLM,
+    PipelineLMConfig,
+)

@@ -1,0 +1,152 @@
+"""Pipeline-parallel Transformer LM — the `pipe`-axis flagship model.
+
+Reference status: pipeline parallelism is **absent** from the MI250X
+project (SURVEY §2.2 "PP: No"); this model is beyond-parity headroom.
+It reuses `transformer_lm.Block` unchanged — same math, same param
+layout per layer — but holds the L blocks as ONE stacked pytree with
+leaves shaped [n_stages, layers_per_stage, ...] so the stage axis can
+shard over the mesh's `pipe` axis (`parallel.pipeline.gpipe_apply`).
+
+Embedding / final norm / lm_head stay replicated: they are a small
+fraction of the FLOPs and keeping them mesh-wide avoids special-casing
+the first/last stage (the classic embedding-on-stage-0 layout is a
+memory optimization this model trades for simplicity).
+
+API mirrors `TransformerLM` (`apply({'params': p}, ids, padding_mask=)`,
+`init_params`) so trainers and losses swap models without changes. The
+mesh is discovered through `runtime.mesh.active_mesh()` — the same
+contract the ring/ulysses attention impls use; without an active mesh
+(or with pipe=1) the stages run sequentially, which is also the
+correctness reference the pipeline is tested against.
+
+Dropout must be 0: per-tick RNG threading through the rotating schedule
+is not implemented (the toy/GPT-2 configs train fine without it; the
+reference's compile benchmark also ran dropout-free).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from hyperion_tpu.models.transformer_lm import Block, TransformerLMConfig
+from hyperion_tpu.parallel.pipeline import gpipe_apply
+from hyperion_tpu.runtime.mesh import AxisName, active_mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineLMConfig:
+    base: TransformerLMConfig
+    n_stages: int = 2
+    n_microbatches: int = 4
+
+    def __post_init__(self):
+        if self.base.n_layers % self.n_stages:
+            raise ValueError(
+                f"n_layers {self.base.n_layers} not divisible by "
+                f"n_stages {self.n_stages}"
+            )
+        if self.base.dropout:
+            raise ValueError("pipeline LM requires dropout=0 (see module doc)")
+
+    @property
+    def layers_per_stage(self) -> int:
+        return self.base.n_layers // self.n_stages
+
+
+class PipelinedLM:
+    """Same-call-surface stand-in for `TransformerLM` with stacked,
+    pipeline-shardable block params."""
+
+    def __init__(self, cfg: PipelineLMConfig):
+        self.cfg = cfg
+
+    # -- init ---------------------------------------------------------
+
+    def init_params(self, rng: jax.Array, batch: int = 2):
+        c = self.cfg.base
+        r_tok, r_pos, r_head, r_blocks = jax.random.split(rng, 4)
+        dummy = jnp.zeros((batch, c.max_len, c.d_model), c.compute_dtype)
+
+        def one_block(r):
+            return Block(c).init(r, dummy, None, True)["params"]
+
+        # [S, lps, ...] stacked leaves: vmap over stage and layer axes
+        # keeps init jit-traceable, so create_train_state can still birth
+        # the params sharded
+        rs = jax.random.split(
+            r_blocks, self.cfg.n_stages * self.cfg.layers_per_stage
+        ).reshape(self.cfg.n_stages, self.cfg.layers_per_stage)
+        stages = jax.vmap(jax.vmap(one_block))(rs)
+
+        normal = jax.nn.initializers.normal(0.02)
+        return {
+            "tok_emb": {"embedding": normal(r_tok, (c.vocab_size, c.d_model))},
+            "pos_emb": {"embedding": normal(r_pos, (c.max_len, c.d_model))},
+            "stages": stages,
+            "ln_f": {
+                "scale": jnp.ones((c.d_model,), jnp.float32),
+                "bias": jnp.zeros((c.d_model,), jnp.float32),
+            },
+            "lm_head": {
+                "kernel": normal(r_head, (c.d_model, c.vocab_size)),
+                "bias": jnp.zeros((c.vocab_size,), jnp.float32),
+            },
+        }
+
+    # -- forward ------------------------------------------------------
+
+    def _stage_fn(self, stage_params, x, pad):
+        """Apply this stage's layers_per_stage blocks sequentially."""
+        c = self.cfg.base
+
+        def body(h, blk):
+            h = Block(c).apply({"params": blk}, h, pad, True)
+            return h, None
+
+        x, _ = jax.lax.scan(body, x, stage_params)
+        return x
+
+    def apply(self, variables, input_ids, padding_mask=None,
+              deterministic: bool = True, rngs=None):
+        del deterministic, rngs  # dropout-free by construction
+        p = variables["params"]
+        c = self.cfg.base
+        B, T = input_ids.shape
+        if T > c.max_len:
+            raise ValueError(f"seq len {T} > max_len {c.max_len}")
+
+        x = p["tok_emb"]["embedding"][input_ids].astype(c.compute_dtype)
+        x = x + p["pos_emb"]["embedding"][:T].astype(c.compute_dtype)[None]
+
+        mesh = active_mesh()
+        if mesh is not None and mesh.shape[AxisName.PIPE] > 1:
+            if mesh.shape[AxisName.PIPE] != self.cfg.n_stages:
+                raise ValueError(
+                    f"model has {self.cfg.n_stages} stages but mesh pipe "
+                    f"axis is {mesh.shape[AxisName.PIPE]}"
+                )
+            x = gpipe_apply(
+                self._stage_fn, p["stages"], x, mesh,
+                n_microbatches=self.cfg.n_microbatches,
+                extras=padding_mask,  # None passes through as empty pytree
+            )
+        else:
+            # sequential reference path: scan stages in order
+            def run_stage(h, stage_p):
+                return self._stage_fn(stage_p, h, padding_mask), None
+
+            x, _ = jax.lax.scan(run_stage, x, p["stages"])
+
+        # final norm + head in fp32 logits, matching TransformerLM
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(-1, keepdims=True)
+        var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+        xn = (xf - mu) * jax.lax.rsqrt(var + 1e-6)
+        xn = xn * p["ln_f"]["scale"] + p["ln_f"]["bias"]
+        logits = xn.astype(c.compute_dtype) @ p["lm_head"]["kernel"].astype(
+            c.compute_dtype
+        ) + p["lm_head"]["bias"].astype(c.compute_dtype)
+        return logits.astype(jnp.float32)

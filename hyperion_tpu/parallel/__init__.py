@@ -1,4 +1,4 @@
-"""Parallelism as sharding layout: DP / FSDP / TP specs over the mesh."""
+"""Parallelism as sharding layout: DP / FSDP / TP / PP specs over the mesh."""
 
 from hyperion_tpu.parallel.partition import (
     TRANSFORMER_TP_RULES,
@@ -7,11 +7,14 @@ from hyperion_tpu.parallel.partition import (
     shard_params,
     shardings_like,
 )
+from hyperion_tpu.parallel.pipeline import gpipe_apply, stage_count
 
 __all__ = [
     "TRANSFORMER_TP_RULES",
+    "gpipe_apply",
     "named_shardings",
     "partition_specs",
     "shard_params",
     "shardings_like",
+    "stage_count",
 ]

@@ -122,24 +122,39 @@ def partition_specs(
     largest free dim of every large param when the `fsdp` axis is >1.
     """
     tp_active = mesh.shape[AxisName.MODEL] > 1
+    pipe_size = mesh.shape.get(AxisName.PIPE, 1)
     fsdp_size = mesh.shape[AxisName.FSDP] if fsdp else 1
     flat = traverse_util.flatten_dict(params, sep="/")
     specs = {}
     for path, leaf in flat.items():
         shape = np.shape(leaf)
-        entries = (None,) * len(shape)
+        # stacked per-stage leaves [S, lps, ...] (parallel.pipeline): the
+        # stage axis lives on `pipe`, and TP templates — which anchor on
+        # the LAYER's leading dims — apply to the trailing shape past the
+        # two stacking dims
+        stacked = (
+            pipe_size > 1
+            and re.match(r"(?:.*/)?stages/", path)
+            and len(shape) >= 1 and shape[0] == pipe_size
+        )
+        lead = ()
+        if stacked:  # [S] alone is possible only for scalar layer params
+            lead = (AxisName.PIPE,) + ((None,) if len(shape) > 1 else ())
+        body_shape = shape[len(lead):]
+        entries = (None,) * len(body_shape)
         if tp_active and tp_rules:
             rule = match_rule(path, tp_rules)
             if rule is not None:
-                entries = _pad_spec(rule, len(shape))
+                entries = _pad_spec(rule, len(body_shape))
                 bad = [
                     (d, a) for d, a in enumerate(entries)
-                    if a is not None and shape[d] % mesh.shape[a]
+                    if a is not None and body_shape[d] % mesh.shape[a]
                 ]
                 if bad:
                     raise ValueError(
                         f"{path}: shape {shape} not divisible by mesh axes {bad}"
                     )
+        entries = lead + entries
         entries = _fsdp_augment(entries, shape, fsdp_size, fsdp_min_size)
         while entries and entries[-1] is None:  # canonical: P() not P(None,...)
             entries = entries[:-1]

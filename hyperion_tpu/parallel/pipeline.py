@@ -1,0 +1,170 @@
+"""GPipe-style pipeline parallelism over the mesh's `pipe` axis.
+
+Reference status: **absent** — SURVEY §2.2's PP row records "No pipeline/
+stage code anywhere" in the MI250X project; this module is beyond-parity
+TPU headroom, built the way the hardware wants it rather than as a
+wrapper class:
+
+  * Each pipeline stage is one mesh coordinate along `pipe` and owns the
+    stacked parameters of its contiguous slice of layers — a pytree
+    whose leaves have leading shape [n_stages, layers_per_stage, ...],
+    sharded `P('pipe')`. No wrapper objects, no per-stage processes:
+    parallelism is a layout decision, exactly like the FSDP/TP rules in
+    `parallel.partition`.
+  * The schedule is a `lax.scan` over S+M-1 ticks (S stages, M
+    microbatches). At tick t, stage s computes microbatch t-s; finished
+    activations hop one stage downstream via `lax.ppermute` over ICI.
+    All of it lives inside one jit — XLA sees a static loop and overlaps
+    the ppermute with the next tick's compute where the hardware allows.
+  * The first stage feeds from the microbatched input buffer, the last
+    stage writes into an output buffer; bubble ticks (t-s outside
+    [0, M)) compute on zeros and their results are never written — the
+    standard GPipe bubble, cost (S-1)/(S+M-1) of the schedule.
+
+Differentiable end to end: ppermute's transpose is the reverse
+ppermute, so `jax.grad` through `gpipe_apply` yields the backward
+pipeline automatically (activations recompute under the caller's remat
+policy like any other jitted graph).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from hyperion_tpu.runtime.mesh import AxisName
+
+
+def stage_count(mesh: Mesh, axis_name: str = AxisName.PIPE) -> int:
+    return mesh.shape[axis_name]
+
+
+def _local_gpipe(
+    stage_params: Any,
+    xs: jax.Array,
+    extras: Any,
+    *,
+    stage_fn: Callable[[Any, jax.Array, Any], jax.Array],
+    axis_name: str,
+    n_micro: int,
+):
+    """Runs inside shard_map. stage_params leaves: [1, lps, ...] (this
+    stage's slice); xs: [M, mb, ...] microbatched inputs (replicated
+    along `pipe`); extras: pytree of [M, ...] per-microbatch side inputs
+    (e.g. padding masks), indexed — not rotated — because every device
+    holds all of them. Returns [1, M, mb, ...]: this stage's output
+    buffer; only the last stage's slice is meaningful."""
+    params = jax.tree.map(lambda a: a[0], stage_params)
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    last = n - 1
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    # scan carries must hold the same varying-axes type as the rotating
+    # activations (jax 0.9 shard_map tracks vma in loop carry types):
+    # stage outputs vary over `pipe` (via params) AND the batch axes
+    # (via xs), so the carry needs the union
+    vma = tuple(
+        set(jax.typeof(jax.tree.leaves(params)[0]).vma)
+        | set(jax.typeof(xs).vma)
+    )
+    pvary = functools.partial(lax.pcast, axis_name=vma, to="varying")
+    state0 = pvary(jnp.zeros(xs.shape[1:], xs.dtype))
+    out0 = pvary(jnp.zeros(xs.shape, xs.dtype))
+
+    def tick(carry, t):
+        state, out = carry
+        # stage s processes microbatch t-s at tick t
+        m_in = jnp.clip(t - stage, 0, n_micro - 1)
+        x_first = lax.dynamic_index_in_dim(
+            xs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+        )
+        x = jnp.where(stage == 0, x_first, state)
+        extra = jax.tree.map(
+            lambda e: lax.dynamic_index_in_dim(e, m_in, 0, keepdims=False),
+            extras,
+        )
+        y = stage_fn(params, x, extra)
+        # the last stage finishes microbatch t-(S-1)
+        widx = t - last
+        valid = (stage == last) & (widx >= 0)
+        slot = jnp.maximum(widx, 0)
+        cur = lax.dynamic_index_in_dim(out, slot, 0, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(valid, y, cur), slot, 0
+        )
+        state = lax.ppermute(y, axis_name, perm)
+        return (state, out), None
+
+    (_, out), _ = lax.scan(
+        tick, (state0, out0), jnp.arange(n + n_micro - 1)
+    )
+    return out[None]
+
+
+def gpipe_apply(
+    stage_fn: Callable[[Any, jax.Array, Any], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    n_microbatches: int,
+    extras: Any = None,
+    axis_name: str = AxisName.PIPE,
+    batch_axes: tuple[str, ...] | None = None,
+) -> jax.Array:
+    """Run `x` through the S-stage pipeline; returns same-shape output.
+
+    stage_fn(params_stage, x_mb, extra_mb) -> y_mb must preserve the
+    activation shape (repeated transformer blocks do). `stage_params`
+    leaves are [S, layers_per_stage, ...] sharded over `axis_name`;
+    `x` is [B, ...] with B divisible by n_microbatches; leaves of
+    `extras` are [B, ...] side inputs that follow their microbatch.
+
+    Memory note: the in_spec `P(axis_name)` gathers each stage's FULL
+    parameter slice (all its layers, all dims) onto its devices for the
+    duration of the step — any fsdp/model sharding of NON-stage dims is
+    undone inside the loop. Per-layer gather inside the tick (true
+    FSDP-within-stage) is future work; until then size stages to fit.
+    """
+    S = mesh.shape[axis_name]
+    B = x.shape[0]
+    M = n_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+    batch_axes = AxisName.BATCH if batch_axes is None else batch_axes
+    n_batch_shards = int(np.prod([mesh.shape[a] for a in batch_axes]))
+    if mb % n_batch_shards:
+        raise ValueError(
+            f"microbatch size {mb} (= batch {B} / {M} microbatches) not "
+            f"divisible by the {n_batch_shards}-way batch sharding "
+            f"{batch_axes}; grow the batch or lower n_microbatches"
+        )
+
+    def to_micro(a):
+        return a.reshape(M, mb, *a.shape[1:])
+
+    xs = to_micro(x)
+    # None stays None: tree.map treats it as an empty pytree, so specs
+    # and indexing pass it through untouched (ring_attention's optional
+    # pad uses the same pattern)
+    extras = jax.tree.map(to_micro, extras)
+
+    mb_spec = P(None, batch_axes)  # [M, mb@batch, ...]
+    fn = shard_map(
+        functools.partial(
+            _local_gpipe, stage_fn=stage_fn, axis_name=axis_name, n_micro=M
+        ),
+        mesh=mesh,
+        in_specs=(P(axis_name), mb_spec, jax.tree.map(lambda _: mb_spec, extras)),
+        out_specs=P(axis_name, None, batch_axes),  # [S@pipe, M, mb@batch, ...]
+    )
+    out = fn(stage_params, xs, extras)  # [S, M, mb, ...]
+    return out[-1].reshape(B, *x.shape[1:])

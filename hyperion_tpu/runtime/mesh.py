@@ -16,6 +16,9 @@ Axes:
          the axis is kept available by design)
   seq    sequence/context parallelism for ring attention (long-context
          headroom; absent in the reference, SURVEY §5.7)
+  pipe   pipeline parallelism: stages hold stacked layer params and
+         activations rotate stage→stage (parallel/pipeline.py; absent in
+         the reference — SURVEY §2.2 PP row — built as TPU headroom)
 """
 
 from __future__ import annotations
@@ -34,8 +37,9 @@ class AxisName:
     FSDP = "fsdp"
     MODEL = "model"
     SEQ = "seq"
+    PIPE = "pipe"
 
-    ALL = (DATA, FSDP, MODEL, SEQ)
+    ALL = (DATA, FSDP, MODEL, SEQ, PIPE)
     # Batch is sharded over every data-like axis: the fsdp axis also
     # consumes batch (FSDP is data-parallel in its activation flow).
     BATCH = (DATA, FSDP)
@@ -50,6 +54,7 @@ class MeshSpec:
     fsdp: int = 1
     model: int = 1
     seq: int = 1
+    pipe: int = 1
 
     def resolve(self, n_devices: int) -> "MeshSpec":
         sizes = dataclasses.asdict(self)
@@ -71,8 +76,8 @@ class MeshSpec:
         return MeshSpec(**sizes)
 
     @property
-    def shape(self) -> tuple[int, int, int, int]:
-        return (self.data, self.fsdp, self.model, self.seq)
+    def shape(self) -> tuple[int, int, int, int, int]:
+        return (self.data, self.fsdp, self.model, self.seq, self.pipe)
 
 
 def make_mesh(

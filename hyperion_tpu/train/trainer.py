@@ -245,8 +245,11 @@ def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int,
     )
     # world-size-specific, like the reference's run ids: a 2-device run
     # must not resume a 1-device run's checkpoint (their shardings and
-    # their scaling-experiment roles differ)
-    ckpt_dir = f"{cfg.train.base_dir}/checkpoints/{job}_{n_devices}dev"
+    # their scaling-experiment roles differ). A pipe mesh additionally
+    # changes the PARAM TREE (stacked stages), so it gets its own dir —
+    # restoring a per-block tree into a stacked one fails in orbax.
+    pipe_tag = f"_pipe{cfg.distributed.pipe}" if cfg.distributed.pipe > 1 else ""
+    ckpt_dir = f"{cfg.train.base_dir}/checkpoints/{job}_{n_devices}dev{pipe_tag}"
     steps_per_epoch = min(len(batches), cfg.train.steps_per_epoch or len(batches))
     if steps_per_epoch <= 0:
         raise ValueError(
@@ -284,13 +287,52 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
 
     policy = get_policy(cfg.optimization.precision)
     tier_impl = _tier_impls(cfg)
-    model = TransformerLM(simple_lm_config(
-        max_len=cfg.train.seq_len,
-        dropout=0.1,
-        remat=cfg.optimization.remat,
-        dtype=jnp.dtype(policy.compute_dtype).name,
-        **tier_impl,
-    ))
+    pipe = mesh.shape["pipe"]
+    if pipe > 1:
+        # pipeline-parallel LM (beyond reference parity — SURVEY §2.2 PP
+        # row): stacked stage params over the pipe axis, dropout-free by
+        # construction (models.pipeline_lm)
+        from hyperion_tpu.models.pipeline_lm import PipelinedLM, PipelineLMConfig
+
+        base = simple_lm_config(
+            max_len=cfg.train.seq_len,
+            dropout=0.0,
+            remat=cfg.optimization.remat,
+            dtype=jnp.dtype(policy.compute_dtype).name,
+            **tier_impl,
+        )
+        if base.n_layers % pipe:
+            # smallest layer count that fills every stage (the toy LM's 2
+            # layers cannot split 4 ways; per-stage depth stays >= 1)
+            n_layers = -(-base.n_layers // pipe) * pipe
+            base = dataclasses.replace(base, n_layers=n_layers)
+        if dist.is_primary():
+            # the pipe run is a different architecture than the plain
+            # job (layer rounding, dropout off) — say so next to the
+            # CSVs it writes rather than only in a code comment
+            print(
+                f"[{job}] pipeline mesh (pipe={pipe}): n_layers="
+                f"{base.n_layers}, dropout=0.0 (plain job: 2 layers, 0.1)"
+            )
+            if is_fsdp:
+                print(
+                    f"[{job}] note: stage params are gathered per step "
+                    "inside the pipeline loop — FSDP's memory ceiling "
+                    "does not apply to the stacked stage leaves"
+                )
+        model = PipelinedLM(PipelineLMConfig(
+            base=base,
+            n_stages=pipe,
+            n_microbatches=cfg.distributed.pipe_microbatches or pipe,
+        ))
+    else:
+        model = TransformerLM(simple_lm_config(
+            max_len=cfg.train.seq_len,
+            dropout=0.1,
+            remat=cfg.optimization.remat,
+            dtype=jnp.dtype(policy.compute_dtype).name,
+            **tier_impl,
+        ))
     optimizer = make_optimizer(
         cfg.train.learning_rate, cfg.train.weight_decay,
         cfg.optimization.grad_clip_norm,

@@ -1,0 +1,215 @@
+"""Pipeline parallelism: gpipe schedule vs sequential reference, grads,
+sharded train step. Runs on the simulated 8-device CPU mesh."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperion_tpu.models.pipeline_lm import PipelinedLM, PipelineLMConfig
+from hyperion_tpu.models.transformer_lm import simple_lm_config
+from hyperion_tpu.runtime.mesh import (
+    AxisName, MeshSpec, activate_mesh, batch_sharding, make_mesh,
+)
+
+VOCAB, T, B = 64, 16, 8
+
+
+def tiny_cfg(n_stages=4, n_micro=4, n_layers=4):
+    return PipelineLMConfig(
+        base=simple_lm_config(
+            vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=n_layers,
+            ff_dim=64, max_len=T, dropout=0.0,
+        ),
+        n_stages=n_stages,
+        n_microbatches=n_micro,
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh_pipe():
+    return make_mesh(MeshSpec(data=2, pipe=4))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_cfg()
+    model = PipelinedLM(cfg)
+    params = model.init_params(jax.random.key(0))
+    ids = np.random.default_rng(0).integers(0, VOCAB, (B, T)).astype(np.int32)
+    return model, {"params": params}, jnp.asarray(ids)
+
+
+class TestGPipeForward:
+    def test_matches_sequential(self, mesh_pipe, setup):
+        model, variables, ids = setup
+        ref = model.apply(variables, ids)  # no active mesh → sequential
+        with activate_mesh(mesh_pipe):
+            out = model.apply(variables, ids)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_matches_sequential_with_padding(self, mesh_pipe, setup):
+        model, variables, ids = setup
+        rng = np.random.default_rng(1)
+        mask = (rng.random((B, T)) > 0.3).astype(np.int8)
+        mask[:, 0] = 1  # never a fully-masked row
+        ref = model.apply(variables, ids, padding_mask=mask)
+        with activate_mesh(mesh_pipe):
+            out = model.apply(variables, ids, padding_mask=mask)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_microbatch_count_independent(self, mesh_pipe, setup):
+        model, variables, _ = setup
+        ids = jnp.asarray(
+            np.random.default_rng(3).integers(0, VOCAB, (16, T)), jnp.int32
+        )
+        with activate_mesh(mesh_pipe):
+            out4 = model.apply(variables, ids)
+            model8 = PipelinedLM(tiny_cfg(n_micro=8))
+            out8 = model8.apply(variables, ids)
+        np.testing.assert_allclose(
+            np.asarray(out4), np.asarray(out8), atol=2e-5, rtol=2e-5
+        )
+
+    def test_undivisible_microbatch_raises(self, mesh_pipe, setup):
+        model, variables, _ = setup
+        ids = jnp.zeros((8, T), jnp.int32)
+        model8 = PipelinedLM(tiny_cfg(n_micro=8))  # mb=1 < 2 batch shards
+        with activate_mesh(mesh_pipe), pytest.raises(ValueError, match="microbatch"):
+            model8.apply(variables, ids)
+
+    def test_stage_mesh_mismatch_raises(self, mesh_pipe, setup):
+        model2 = PipelinedLM(tiny_cfg(n_stages=2))
+        params = model2.init_params(jax.random.key(0))
+        ids = jnp.zeros((B, T), jnp.int32)
+        with activate_mesh(mesh_pipe), pytest.raises(ValueError, match="stages"):
+            model2.apply({"params": params}, ids)
+
+
+class TestGPipeBackward:
+    def test_grads_match_sequential(self, mesh_pipe, setup):
+        model, variables, ids = setup
+
+        def loss(params, pipelined):
+            ctx = activate_mesh(mesh_pipe) if pipelined else _null()
+            with ctx:
+                logits = model.apply({"params": params}, ids)
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+        g_ref = jax.grad(lambda p: loss(p, False))(variables["params"])
+        g_pipe = jax.grad(lambda p: loss(p, True))(variables["params"])
+        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_pipe)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-5, rtol=3e-4
+            )
+
+
+class _null:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
+class TestPipelineTrainStep:
+    @pytest.mark.slow
+    def test_full_train_step_sharded(self, mesh_pipe):
+        from hyperion_tpu.train import (
+            create_train_state, make_optimizer, make_train_step, next_token_loss,
+        )
+
+        cfg = tiny_cfg()
+        model = PipelinedLM(cfg)
+        opt = make_optimizer(1e-3, grad_clip_norm=1.0)
+        with activate_mesh(mesh_pipe):
+            state, sharding = create_train_state(
+                lambda r: {"params": model.init_params(r)}, opt, mesh_pipe,
+                jax.random.key(0), policy="fp32", fsdp=False,
+            )
+            # stacked stage leaves live on the pipe axis
+            specs = jax.tree.map(
+                lambda s: s.spec, sharding.params["stages"]
+            )
+            assert all(
+                sp[0] == AxisName.PIPE for sp in jax.tree.leaves(
+                    specs, is_leaf=lambda x: hasattr(x, "index")
+                )
+            )
+
+            def loss_fn(params, batch_stats, batch, rngs):
+                logits = model.apply(
+                    {"params": params}, batch["input_ids"],
+                    padding_mask=batch["attention_mask"],
+                )
+                loss = next_token_loss(
+                    logits, batch["input_ids"], batch["attention_mask"]
+                )
+                return loss, ({"loss": loss}, batch_stats)
+
+            step = make_train_step(loss_fn, opt, sharding)
+            ids = np.random.default_rng(2).integers(0, VOCAB, (B, T))
+            sh = batch_sharding(mesh_pipe)
+            batch = {
+                "input_ids": jax.device_put(ids.astype(np.int32), sh),
+                "attention_mask": jax.device_put(np.ones((B, T), np.int8), sh),
+            }
+            state, metrics = step(state, batch, jax.random.key(1))
+            assert np.isfinite(float(metrics["loss"]))
+
+
+class TestPartitionSpecs:
+    def test_stages_claim_pipe_axis(self, mesh_pipe):
+        from hyperion_tpu.parallel.partition import partition_specs
+
+        model = PipelinedLM(tiny_cfg())
+        params = jax.eval_shape(
+            lambda r: model.init_params(r), jax.random.key(0)
+        )
+        from flax import traverse_util
+        from jax.sharding import PartitionSpec
+
+        specs = partition_specs(params, mesh_pipe, fsdp=False)
+        flat = traverse_util.flatten_dict(
+            specs, sep="/", is_leaf=lambda _, v: isinstance(v, PartitionSpec)
+        )
+        # any stages leaf: first axis pipe; embeddings replicated
+        stage_specs = [v for k, v in flat.items() if "stages/" in k]
+        assert stage_specs and all(
+            sp and sp[0] == AxisName.PIPE for sp in stage_specs
+        )
+        assert flat["tok_emb/embedding"] == PartitionSpec()
+
+    def test_tp_rules_shift_past_stacking_dims(self):
+        """PP+TP: TP templates anchor on the LAYER's dims, so on stacked
+        [S, lps, ...] leaves they must shift right past stage/layer dims
+        (regression: 'model' used to land on the stage axis)."""
+        from hyperion_tpu.parallel.partition import (
+            TRANSFORMER_TP_RULES, partition_specs,
+        )
+
+        mesh = make_mesh(MeshSpec(data=2, model=2, pipe=2))
+        model = PipelinedLM(tiny_cfg(n_stages=2))
+        params = jax.eval_shape(
+            lambda r: model.init_params(r), jax.random.key(0)
+        )
+        specs = partition_specs(
+            params, mesh, tp_rules=TRANSFORMER_TP_RULES, fsdp=False
+        )
+        from flax import traverse_util
+        from jax.sharding import PartitionSpec
+
+        flat = traverse_util.flatten_dict(
+            specs, sep="/", is_leaf=lambda _, v: isinstance(v, PartitionSpec)
+        )
+        qk = flat["stages/attn/q_proj/kernel"]  # [S, lps, d, H, hd]
+        assert qk[0] == AxisName.PIPE
+        assert AxisName.MODEL in qk and qk.index(AxisName.MODEL) == 3
+        qb = flat["stages/attn/q_proj/bias"]  # [S, lps, H, hd]
+        assert qb[0] == AxisName.PIPE and qb[2] == AxisName.MODEL
