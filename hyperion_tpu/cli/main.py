@@ -47,12 +47,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--precision", choices=["fp32", "bf16", "bf16_full"],
                    default="bf16")
     p.add_argument("--mesh", default=None,
-                   help="axis sizes data,fsdp,model,seq[,pipe] (e.g. "
-                        "2,4,1,1 or 2,1,1,1,4); default: all-data, or "
-                        "all-fsdp for *_fsdp jobs")
+                   help="axis sizes data,fsdp,model,seq[,pipe[,expert]] "
+                        "(e.g. 2,4,1,1 or 2,1,1,1,4); default: all-data, "
+                        "or all-fsdp for *_fsdp jobs")
     p.add_argument("--pipe_microbatches", type=int, default=0,
                    help="GPipe microbatches when the mesh has a pipe "
                         "axis (0 = one per stage)")
+    p.add_argument("--moe_experts", type=int, default=0,
+                   help="language jobs: >0 swaps in the MoE LM with this "
+                        "many experts (shard them with --mesh's expert "
+                        "axis)")
+    p.add_argument("--moe_top_k", type=int, default=2)
     p.add_argument("--devices", type=int, default=0,
                    help="restrict to first N devices (scaling runs)")
     p.add_argument("--scaling_devices", type=int, nargs="*", default=None,
@@ -124,13 +129,17 @@ def make_config(args, job: str) -> Config:
         cfg.optimization.grad_clip_norm = 1.0  # reference clip 1.0 (:351,522)
     cfg.distributed.max_devices = args.devices
     cfg.distributed.pipe_microbatches = args.pipe_microbatches
+    cfg.train.moe_experts = args.moe_experts
+    cfg.train.moe_top_k = args.moe_top_k
     if args.mesh:
         sizes = [int(x) for x in args.mesh.split(",")]
-        if len(sizes) not in (4, 5):
+        if len(sizes) not in (4, 5, 6):
             raise SystemExit(
-                f"--mesh wants data,fsdp,model,seq[,pipe], got {args.mesh!r}"
+                "--mesh wants data,fsdp,model,seq[,pipe[,expert]], got "
+                f"{args.mesh!r}"
             )
-        for name, v in zip(("data", "fsdp", "model", "seq", "pipe"), sizes):
+        axes = ("data", "fsdp", "model", "seq", "pipe", "expert")
+        for name, v in zip(axes, sizes):
             setattr(cfg.distributed, name, v)
     elif job in ("language_fsdp",) or (job == "llama" and not args.lora):
         cfg.distributed.data = 1

@@ -62,6 +62,7 @@ class DistributedConfig:
     seq: int = 1
     pipe: int = 1                    # pipeline stages (parallel.pipeline)
     pipe_microbatches: int = 0       # 0 = same as pipe (GPipe M >= S)
+    expert: int = 1                  # expert-parallel shards (ops.moe)
     max_devices: int = 0  # 0 = all; >0 restricts the mesh to the first N
     coordinator_address: str | None = None
     num_processes: int | None = None
@@ -69,7 +70,7 @@ class DistributedConfig:
 
     def mesh_spec(self) -> MeshSpec:
         return MeshSpec(data=self.data, fsdp=self.fsdp, model=self.model,
-                        seq=self.seq, pipe=self.pipe)
+                        seq=self.seq, pipe=self.pipe, expert=self.expert)
 
 
 @dataclasses.dataclass
@@ -93,6 +94,9 @@ class TrainConfig:
     lora_rank: int = 16              # reference LoraConfig r=16 α=32 (:470)
     lora_alpha: float = 32.0
     lora_dropout: float = 0.05
+    moe_experts: int = 0             # >0: language jobs use the MoE LM
+    moe_top_k: int = 2
+    moe_every: int = 2               # every k-th block is sparse
 
 
 @dataclasses.dataclass

@@ -42,3 +42,4 @@ from hyperion_tpu.models.pipeline_lm import (  # noqa: F401
     PipelinedLM,
     PipelineLMConfig,
 )
+from hyperion_tpu.models.moe_lm import MoELM, MoELMConfig  # noqa: F401

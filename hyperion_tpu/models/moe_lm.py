@@ -1,0 +1,130 @@
+"""MoE Transformer LM — sparse FFN layers with expert parallelism.
+
+Beyond reference parity (SURVEY §2.2 EP row: absent upstream). The
+dense `transformer_lm.Block` stays the backbone; every `moe_every`-th
+block swaps its FFN for `ops.moe.moe_ffn` (top-k routed, statically
+shaped, experts sharded over the mesh's `expert` axis). The router's
+load-balancing auxiliary losses are `sow`n as intermediates and summed
+by `apply_with_aux`, which trainers add to the LM loss scaled by
+`aux_weight`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from hyperion_tpu.models.transformer_lm import (
+    MHA, TransformerLMConfig, _norm, lm_backbone, remat_block_cls,
+)
+from hyperion_tpu.ops.moe import MoEConfig, moe_ffn
+
+
+@dataclasses.dataclass(frozen=True)
+class MoELMConfig:
+    base: TransformerLMConfig
+    moe: MoEConfig
+    moe_every: int = 2     # every k-th block is sparse (1 = all MoE)
+    aux_weight: float = 0.01
+
+    def __post_init__(self):
+        if self.moe.d_model != self.base.d_model:
+            raise ValueError(
+                f"moe.d_model {self.moe.d_model} != base.d_model "
+                f"{self.base.d_model}"
+            )
+
+
+class _ExpertBank(nn.Module):
+    """Parameter holder: stacked [E, ...] expert FFN weights under an
+    `experts/` scope so `parallel.partition` claims dim 0 for the
+    expert axis."""
+
+    moe: MoEConfig
+
+    @nn.compact
+    def __call__(self) -> dict:
+        E, d, f = self.moe.n_experts, self.moe.d_model, self.moe.ff_dim
+        stacked = jax.nn.initializers.variance_scaling(
+            1.0, "fan_avg", "uniform", in_axis=-2, out_axis=-1, batch_axis=0,
+        )
+        return {
+            "wi": self.param("wi", stacked, (E, d, f), jnp.float32),
+            "bi": self.param("bi", nn.initializers.zeros, (E, f), jnp.float32),
+            "wo": self.param("wo", stacked, (E, f, d), jnp.float32),
+            "bo": self.param("bo", nn.initializers.zeros, (E, d), jnp.float32),
+        }
+
+
+class MoEBlock(nn.Module):
+    cfg: TransformerLMConfig
+    moe: MoEConfig
+
+    @nn.compact
+    def __call__(self, x, padding_mask, deterministic: bool):
+        c = self.cfg
+        h = _norm(c, "ln1")(x)
+        h = MHA(c, name="attn")(h, padding_mask, deterministic)
+        h = nn.Dropout(c.dropout, deterministic=deterministic)(h)
+        x = x + h
+        h = _norm(c, "ln2")(x)
+        params = {
+            "router": {
+                "kernel": self.param(
+                    "router",
+                    nn.initializers.xavier_uniform(),
+                    (c.d_model, self.moe.n_experts),
+                    jnp.float32,
+                )
+            },
+            "experts": _ExpertBank(self.moe, name="experts")(),
+        }
+        y, aux = moe_ffn(params, h, self.moe)
+        self.sow("intermediates", "moe_aux", aux)
+        y = nn.Dropout(c.dropout, deterministic=deterministic)(y)
+        return x + y
+
+
+class MoELM(nn.Module):
+    """TransformerLM with sparse FFN layers; same call surface, plus
+    `apply_with_aux` for the routed auxiliary loss."""
+
+    cfg: MoELMConfig
+
+    @nn.compact
+    def __call__(self, input_ids, padding_mask=None, deterministic: bool = True):
+        c = self.cfg.base
+        dense_cls = remat_block_cls(c)
+        sparse_cls = remat_block_cls(c, MoEBlock)
+
+        def make_block(i):
+            if (i + 1) % self.cfg.moe_every == 0:
+                return sparse_cls(c, self.cfg.moe, name=f"moe_block_{i}")
+            return dense_cls(c, name=f"block_{i}")
+
+        return lm_backbone(
+            c, input_ids, padding_mask, deterministic, make_block
+        )
+
+    def init_params(self, rng: jax.Array, batch: int = 2):
+        ids = jnp.zeros((batch, self.cfg.base.max_len), jnp.int32)
+        return self.init(rng, ids)["params"]
+
+    def apply_with_aux(self, variables, input_ids, padding_mask=None,
+                       deterministic: bool = True, rngs=None):
+        """(logits, aux): aux = mean of every MoE layer's load-balancing
+        loss, pre-scaled by cfg.aux_weight — add it to the LM loss."""
+        logits, mut = self.apply(
+            variables, input_ids, padding_mask=padding_mask,
+            deterministic=deterministic, rngs=rngs,
+            mutable=["intermediates"],
+        )
+        leaves = jax.tree.leaves(mut.get("intermediates", {}))
+        aux = (
+            sum(jnp.asarray(a).sum() for a in leaves) / max(1, len(leaves))
+            if leaves else jnp.float32(0)
+        )
+        return logits, self.cfg.aux_weight * aux

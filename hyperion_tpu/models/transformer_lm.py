@@ -147,6 +147,61 @@ class Block(nn.Module):
         return x + h
 
 
+def remat_block_cls(cfg: TransformerLMConfig, block_cls=None):
+    """Block class (default `Block`) wrapped per cfg.remat_policy — the
+    activation-checkpointing knob both LM variants must honour."""
+    block_cls = block_cls or Block
+    if cfg.remat_policy == "none":
+        return block_cls
+    from hyperion_tpu.precision.remat import REMAT_POLICIES
+
+    return nn.remat(
+        block_cls, static_argnums=(3,),
+        policy=REMAT_POLICIES[cfg.remat_policy],
+    )
+
+
+def lm_backbone(c: TransformerLMConfig, input_ids, padding_mask,
+                deterministic: bool, make_block):
+    """Shared LM scaffold (embeddings → blocks → final norm → head),
+    used by TransformerLM and MoELM so the two cannot drift. Must be
+    called from inside an @nn.compact __call__; `make_block(i)` returns
+    the (possibly remat-wrapped) block module for layer i, already
+    named."""
+    T = input_ids.shape[1]
+    if T > c.max_len:
+        raise ValueError(
+            f"sequence length {T} exceeds max_len {c.max_len} — the "
+            f"positional table has no rows past max_len"
+        )
+    x = nn.Embed(
+        c.vocab_size,
+        c.d_model,
+        dtype=c.compute_dtype,
+        embedding_init=nn.initializers.normal(0.02),
+        name="tok_emb",
+    )(input_ids)
+    pos = nn.Embed(
+        c.max_len,
+        c.d_model,
+        dtype=c.compute_dtype,
+        embedding_init=nn.initializers.normal(0.02),
+        name="pos_emb",
+    )(jnp.arange(T, dtype=jnp.int32))
+    x = x + pos[None]
+    x = nn.Dropout(c.dropout, deterministic=deterministic)(x)
+    for i in range(c.n_layers):
+        x = make_block(i)(x, padding_mask, deterministic)
+    x = _norm(c, "ln_f")(x)
+    logits = nn.Dense(
+        c.vocab_size,
+        dtype=c.compute_dtype,
+        kernel_init=nn.initializers.normal(0.02),
+        name="lm_head",
+    )(x)
+    return logits.astype(jnp.float32)
+
+
 class TransformerLM(nn.Module):
     cfg: TransformerLMConfig
 
@@ -154,47 +209,11 @@ class TransformerLM(nn.Module):
     def __call__(self, input_ids, padding_mask=None, deterministic: bool = True):
         """input_ids: int32 [B, T] → logits fp32 [B, T, vocab]."""
         c = self.cfg
-        T = input_ids.shape[1]
-        if T > c.max_len:
-            raise ValueError(
-                f"sequence length {T} exceeds max_len {c.max_len} — the "
-                f"positional table has no rows past max_len"
-            )
-        x = nn.Embed(
-            c.vocab_size,
-            c.d_model,
-            dtype=c.compute_dtype,
-            embedding_init=nn.initializers.normal(0.02),
-            name="tok_emb",
-        )(input_ids)
-        pos = nn.Embed(
-            c.max_len,
-            c.d_model,
-            dtype=c.compute_dtype,
-            embedding_init=nn.initializers.normal(0.02),
-            name="pos_emb",
-        )(jnp.arange(T, dtype=jnp.int32))
-        x = x + pos[None]
-        x = nn.Dropout(c.dropout, deterministic=deterministic)(x)
-
-        block = Block
-        if c.remat_policy != "none":
-            from hyperion_tpu.precision.remat import REMAT_POLICIES
-
-            block = nn.remat(
-                Block, static_argnums=(3,),
-                policy=REMAT_POLICIES[c.remat_policy],
-            )
-        for i in range(c.n_layers):
-            x = block(c, name=f"block_{i}")(x, padding_mask, deterministic)
-        x = _norm(c, "ln_f")(x)
-        logits = nn.Dense(
-            c.vocab_size,
-            dtype=c.compute_dtype,
-            kernel_init=nn.initializers.normal(0.02),
-            name="lm_head",
-        )(x)
-        return logits.astype(jnp.float32)
+        block = remat_block_cls(c)
+        return lm_backbone(
+            c, input_ids, padding_mask, deterministic,
+            lambda i: block(c, name=f"block_{i}"),
+        )
 
     def init_params(self, rng: jax.Array, batch: int = 2):
         ids = jnp.zeros((batch, self.cfg.max_len), jnp.int32)

@@ -11,3 +11,9 @@ from hyperion_tpu.ops.attention import dot_product_attention  # noqa: F401
 # ring_attention submodule path
 from hyperion_tpu.ops.ring_attention import ring_attention, seq_sharding  # noqa: F401
 from hyperion_tpu.ops.ulysses import ulysses_attention  # noqa: F401
+from hyperion_tpu.ops.moe import (  # noqa: F401
+    MoEConfig,
+    init_moe_params,
+    moe_ffn,
+    top_k_routing,
+)

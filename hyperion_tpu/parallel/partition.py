@@ -123,23 +123,38 @@ def partition_specs(
     """
     tp_active = mesh.shape[AxisName.MODEL] > 1
     pipe_size = mesh.shape.get(AxisName.PIPE, 1)
+    expert_size = mesh.shape.get(AxisName.EXPERT, 1)
     fsdp_size = mesh.shape[AxisName.FSDP] if fsdp else 1
     flat = traverse_util.flatten_dict(params, sep="/")
     specs = {}
     for path, leaf in flat.items():
         shape = np.shape(leaf)
-        # stacked per-stage leaves [S, lps, ...] (parallel.pipeline): the
-        # stage axis lives on `pipe`, and TP templates — which anchor on
-        # the LAYER's leading dims — apply to the trailing shape past the
-        # two stacking dims
-        stacked = (
+        # stacked leaves claim their stacking axis on dim 0, and TP
+        # templates — which anchor on the LAYER's leading dims — apply
+        # to the trailing shape past the stacking dims:
+        #   stages/**  [S, lps, ...] → pipe   (parallel.pipeline)
+        #   experts/** [E, ...]      → expert (ops.moe)
+        lead = ()
+        if (
             pipe_size > 1
             and re.match(r"(?:.*/)?stages/", path)
             and len(shape) >= 1 and shape[0] == pipe_size
-        )
-        lead = ()
-        if stacked:  # [S] alone is possible only for scalar layer params
+        ):  # [S] alone is possible only for scalar layer params
             lead = (AxisName.PIPE,) + ((None,) if len(shape) > 1 else ())
+        elif (
+            expert_size > 1
+            and re.match(r"(?:.*/)?experts/", path)
+            and len(shape) >= 1
+        ):
+            # n_experts need only DIVIDE the axis-shard count (the usual
+            # GShard setup has several experts per coordinate); an
+            # indivisible count is a config error, not a silent replicate
+            if shape[0] % expert_size:
+                raise ValueError(
+                    f"{path}: {shape[0]} experts not divisible by the "
+                    f"{expert_size}-way expert mesh axis"
+                )
+            lead = (AxisName.EXPERT,)
         body_shape = shape[len(lead):]
         entries = (None,) * len(body_shape)
         if tp_active and tp_rules:

@@ -19,6 +19,9 @@ Axes:
   pipe   pipeline parallelism: stages hold stacked layer params and
          activations rotate stage→stage (parallel/pipeline.py; absent in
          the reference — SURVEY §2.2 PP row — built as TPU headroom)
+  expert expert parallelism: MoE expert weights live one-expert-set per
+         coordinate and token blocks all-to-all to them (ops/moe.py;
+         absent in the reference — SURVEY §2.2 EP row)
 """
 
 from __future__ import annotations
@@ -38,8 +41,9 @@ class AxisName:
     MODEL = "model"
     SEQ = "seq"
     PIPE = "pipe"
+    EXPERT = "expert"
 
-    ALL = (DATA, FSDP, MODEL, SEQ, PIPE)
+    ALL = (DATA, FSDP, MODEL, SEQ, PIPE, EXPERT)
     # Batch is sharded over every data-like axis: the fsdp axis also
     # consumes batch (FSDP is data-parallel in its activation flow).
     BATCH = (DATA, FSDP)
@@ -55,6 +59,7 @@ class MeshSpec:
     model: int = 1
     seq: int = 1
     pipe: int = 1
+    expert: int = 1
 
     def resolve(self, n_devices: int) -> "MeshSpec":
         sizes = dataclasses.asdict(self)
@@ -76,8 +81,9 @@ class MeshSpec:
         return MeshSpec(**sizes)
 
     @property
-    def shape(self) -> tuple[int, int, int, int, int]:
-        return (self.data, self.fsdp, self.model, self.seq, self.pipe)
+    def shape(self) -> tuple[int, ...]:
+        return (self.data, self.fsdp, self.model, self.seq, self.pipe,
+                self.expert)
 
 
 def make_mesh(

@@ -248,8 +248,10 @@ def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int,
     # their scaling-experiment roles differ). A pipe mesh additionally
     # changes the PARAM TREE (stacked stages), so it gets its own dir —
     # restoring a per-block tree into a stacked one fails in orbax.
-    pipe_tag = f"_pipe{cfg.distributed.pipe}" if cfg.distributed.pipe > 1 else ""
-    ckpt_dir = f"{cfg.train.base_dir}/checkpoints/{job}_{n_devices}dev{pipe_tag}"
+    tag = f"_pipe{cfg.distributed.pipe}" if cfg.distributed.pipe > 1 else ""
+    if cfg.train.moe_experts:  # MoE is a different param tree too
+        tag += f"_moe{cfg.train.moe_experts}"
+    ckpt_dir = f"{cfg.train.base_dir}/checkpoints/{job}_{n_devices}dev{tag}"
     steps_per_epoch = min(len(batches), cfg.train.steps_per_epoch or len(batches))
     if steps_per_epoch <= 0:
         raise ValueError(
@@ -288,6 +290,11 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
     policy = get_policy(cfg.optimization.precision)
     tier_impl = _tier_impls(cfg)
     pipe = mesh.shape["pipe"]
+    if pipe > 1 and cfg.train.moe_experts > 0:
+        raise ValueError(
+            "pipeline + MoE in one language run is not supported yet — "
+            "drop the pipe axis or moe_experts"
+        )
     if pipe > 1:
         # pipeline-parallel LM (beyond reference parity — SURVEY §2.2 PP
         # row): stacked stage params over the pipe axis, dropout-free by
@@ -325,6 +332,30 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
             n_stages=pipe,
             n_microbatches=cfg.distributed.pipe_microbatches or pipe,
         ))
+    elif cfg.train.moe_experts > 0:
+        # sparse-FFN LM (beyond reference parity — SURVEY §2.2 EP row);
+        # shard the experts with an `expert` mesh axis (--mesh ...,E)
+        from hyperion_tpu.models.moe_lm import MoELM, MoELMConfig
+        from hyperion_tpu.ops.moe import MoEConfig
+
+        base = simple_lm_config(
+            max_len=cfg.train.seq_len,
+            dropout=0.1,
+            remat=cfg.optimization.remat,
+            dtype=jnp.dtype(policy.compute_dtype).name,
+            **tier_impl,
+        )
+        model = MoELM(MoELMConfig(
+            base=base,
+            moe=MoEConfig(
+                n_experts=cfg.train.moe_experts,
+                top_k=cfg.train.moe_top_k,
+                d_model=base.d_model,
+                ff_dim=base.ff_dim,
+                activation=base.activation,
+            ),
+            moe_every=cfg.train.moe_every,
+        ))
     else:
         model = TransformerLM(simple_lm_config(
             max_len=cfg.train.seq_len,
@@ -346,13 +377,25 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
         fsdp=is_fsdp,
     )
 
+    has_aux = hasattr(model, "apply_with_aux")  # MoE router balance loss
+
     def loss_fn(params, batch_stats, batch, rngs):
-        logits = model.apply(
-            {"params": params}, batch["input_ids"],
-            padding_mask=batch["attention_mask"],
-            deterministic=rngs is None, rngs=rngs,
-        )
-        loss = next_token_loss(logits, batch["input_ids"], batch["attention_mask"])
+        if has_aux:
+            logits, aux = model.apply_with_aux(
+                {"params": params}, batch["input_ids"],
+                padding_mask=batch["attention_mask"],
+                deterministic=rngs is None, rngs=rngs,
+            )
+        else:
+            logits = model.apply(
+                {"params": params}, batch["input_ids"],
+                padding_mask=batch["attention_mask"],
+                deterministic=rngs is None, rngs=rngs,
+            )
+            aux = 0.0
+        loss = next_token_loss(
+            logits, batch["input_ids"], batch["attention_mask"]
+        ) + aux
         return loss, ({"loss": loss}, batch_stats)
 
     train_step = make_train_step(

@@ -18,7 +18,7 @@ class TestConfig:
     def test_mesh_spec_bridge(self):
         cfg = default_config()
         cfg.distributed.fsdp = 2
-        assert cfg.distributed.mesh_spec().resolve(8).shape == (4, 2, 1, 1, 1)
+        assert cfg.distributed.mesh_spec().resolve(8).shape == (4, 2, 1, 1, 1, 1)
 
     def test_override_dotted(self):
         cfg = default_config().override(**{"train.learning_rate": 1e-3, "optimization.remat": "dots"})

@@ -17,10 +17,10 @@ from hyperion_tpu.runtime.mesh import (
 
 class TestMeshSpec:
     def test_infer_axis(self):
-        assert MeshSpec(data=-1, fsdp=2).resolve(8).shape == (4, 2, 1, 1, 1)
+        assert MeshSpec(data=-1, fsdp=2).resolve(8).shape == (4, 2, 1, 1, 1, 1)
 
     def test_explicit(self):
-        assert MeshSpec(data=2, fsdp=2, model=2).resolve(8).shape == (2, 2, 2, 1, 1)
+        assert MeshSpec(data=2, fsdp=2, model=2).resolve(8).shape == (2, 2, 2, 1, 1, 1)
 
     def test_mismatch_raises(self):
         with pytest.raises(ValueError):
