@@ -82,7 +82,7 @@ class MoEBlock(nn.Module):
             },
             "experts": _ExpertBank(self.moe, name="experts")(),
         }
-        y, aux = moe_ffn(params, h, self.moe)
+        y, aux = moe_ffn(params, h, self.moe, padding_mask=padding_mask)
         self.sow("intermediates", "moe_aux", aux)
         y = nn.Dropout(c.dropout, deterministic=deterministic)(y)
         return x + y

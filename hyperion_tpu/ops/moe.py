@@ -45,6 +45,18 @@ class MoEConfig:
     d_model: int = 256
     ff_dim: int = 1024
     activation: str = "gelu"
+    # routing group size in tokens (GShard's G dimension): dispatch
+    # memory is O(group * E * capacity) PER GROUP, linear in total
+    # tokens — without grouping it would grow quadratically. 0 = one
+    # group per batch row (group = seq_len).
+    group_size: int = 0
+
+    def __post_init__(self):
+        if not 1 <= self.top_k <= self.n_experts:
+            raise ValueError(
+                f"need 1 <= top_k <= n_experts, got top_k={self.top_k} "
+                f"n_experts={self.n_experts}"
+            )
 
     def capacity(self, n_tokens: int) -> int:
         per = -(-self.top_k * n_tokens // self.n_experts)  # ceil
@@ -72,7 +84,8 @@ def init_moe_params(rng: jax.Array, cfg: MoEConfig) -> dict:
     }
 
 
-def top_k_routing(probs: jax.Array, cfg: MoEConfig, capacity: int):
+def top_k_routing(probs: jax.Array, cfg: MoEConfig, capacity: int,
+                  valid: jax.Array | None = None):
     """probs [N, E] → (dispatch [N, E, C] bool-ish, combine [N, E, C]).
 
     Slot positions come from a cumulative count over the token dim, with
@@ -80,7 +93,12 @@ def top_k_routing(probs: jax.Array, cfg: MoEConfig, capacity: int):
     ordering). Gates are normalized over ALL top-k picks before capacity
     is applied, so a token whose pick overflows capacity simply loses
     that share of its output (it passes through the residual instead) —
-    dropped mass is not re-routed to the surviving pick."""
+    dropped mass is not re-routed to the surviving pick.
+
+    `valid` ([N], 1 = real token): padding tokens are excluded from
+    dispatch entirely — they consume no capacity slots and get zero
+    combine weight (their block output is 0; the residual carries them).
+    """
     N, E = probs.shape
     masks, gates = [], []
     p = probs
@@ -88,7 +106,7 @@ def top_k_routing(probs: jax.Array, cfg: MoEConfig, capacity: int):
         idx = jnp.argmax(p, axis=-1)
         mask = jax.nn.one_hot(idx, E, dtype=probs.dtype)  # [N, E]
         gates.append(jnp.sum(probs * mask, axis=-1))      # original prob
-        masks.append(mask)
+        masks.append(mask if valid is None else mask * valid[:, None])
         p = p * (1.0 - mask)
 
     dispatch = jnp.zeros((N, E, capacity), probs.dtype)
@@ -109,49 +127,77 @@ def top_k_routing(probs: jax.Array, cfg: MoEConfig, capacity: int):
     return dispatch, combine
 
 
-def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig):
+def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig,
+            padding_mask: jax.Array | None = None):
     """x [B, T, d] → (y [B, T, d], aux_loss scalar).
 
-    The expert einsums run with the [E, C, d] token blocks and [E, ...]
-    weights sharded over the mesh's `expert` axis when one is active —
-    GSPMD turns the dispatch/return einsums into the token all-to-all.
+    Tokens route within fixed-size GROUPS (GShard's G dimension, default
+    one group per batch row) so dispatch/combine are [G, g, E, C] with
+    C ∝ g/E — memory linear in total tokens, not quadratic. The expert
+    einsums run with the [G, E, C, d] token blocks and [E, ...] weights
+    sharded over the mesh's `expert` axis when one is active — GSPMD
+    turns the dispatch/return einsums into the token all-to-all.
+
+    `padding_mask` ([B, T], 1 = real): pads neither consume expert
+    capacity nor count in the load-balancing loss; their output is 0
+    (the residual carries them).
     """
     B, T, d = x.shape
     N = B * T
     E = cfg.n_experts
     act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[cfg.activation]
-    capacity = cfg.capacity(N)
+    g = cfg.group_size or T
+    if N % g:
+        raise ValueError(f"{N} tokens not divisible by group_size {g}")
+    G = N // g
+    capacity = cfg.capacity(g)  # per group
 
-    tokens = x.reshape(N, d)
-    logits = tokens.astype(jnp.float32) @ params["router"]["kernel"]
-    probs = jax.nn.softmax(logits, axis=-1)  # [N, E] fp32
+    xg = x.reshape(G, g, d)
+    logits = jnp.einsum(
+        "gnd,de->gne", xg.astype(jnp.float32), params["router"]["kernel"]
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [G, g, E] fp32
 
-    dispatch, combine = top_k_routing(probs, cfg, capacity)
+    if padding_mask is None:
+        route = jax.vmap(lambda p: top_k_routing(p, cfg, capacity))
+        dispatch, combine = route(probs)
+        valid = None
+    else:
+        valid = padding_mask.reshape(G, g).astype(jnp.float32)
+        route = jax.vmap(lambda p, v: top_k_routing(p, cfg, capacity, v))
+        dispatch, combine = route(probs, valid)
     dispatch = dispatch.astype(x.dtype)
     combine = combine.astype(x.dtype)
 
-    # token blocks to experts: [N, E, C] x [N, d] → [E, C, d]
-    xe = jnp.einsum("nec,nd->ecd", dispatch, tokens)
+    # token blocks to experts: [G, g, E, C] x [G, g, d] → [G, E, C, d]
+    xe = jnp.einsum("gnec,gnd->gecd", dispatch, xg)
     mesh = active_mesh()
     ep = mesh is not None and mesh.shape[AxisName.EXPERT] > 1
     if ep:
         xe = lax.with_sharding_constraint(
-            xe, NamedSharding(mesh, P(AxisName.EXPERT))
+            xe, NamedSharding(mesh, P(None, AxisName.EXPERT))
         )
     w = params["experts"]
-    h = act(jnp.einsum("ecd,edf->ecf", xe, w["wi"].astype(x.dtype))
-            + w["bi"].astype(x.dtype)[:, None, :])
-    ye = jnp.einsum("ecf,efd->ecd", h, w["wo"].astype(x.dtype))
-    ye = ye + w["bo"].astype(x.dtype)[:, None, :]
+    h = act(jnp.einsum("gecd,edf->gecf", xe, w["wi"].astype(x.dtype))
+            + w["bi"].astype(x.dtype)[None, :, None, :])
+    ye = jnp.einsum("gecf,efd->gecd", h, w["wo"].astype(x.dtype))
+    ye = ye + w["bo"].astype(x.dtype)[None, :, None, :]
     if ep:
         ye = lax.with_sharding_constraint(
-            ye, NamedSharding(mesh, P(AxisName.EXPERT))
+            ye, NamedSharding(mesh, P(None, AxisName.EXPERT))
         )
-    y = jnp.einsum("nec,ecd->nd", combine, ye)
+    y = jnp.einsum("gnec,gecd->gnd", combine, ye)
 
-    # GShard load-balance loss: E * Σ_e (top-1 token fraction)·(mean prob)
+    # GShard load-balance loss over REAL tokens only:
+    # E * Σ_e (top-1 token fraction)·(mean prob)
     top1 = jax.nn.one_hot(jnp.argmax(probs, -1), E, dtype=jnp.float32)
-    f_e = top1.mean(axis=0)
-    p_e = probs.mean(axis=0)
+    if valid is None:
+        f_e = top1.mean(axis=(0, 1))
+        p_e = probs.mean(axis=(0, 1))
+    else:
+        wt = valid[..., None]
+        denom = jnp.maximum(valid.sum(), 1.0)
+        f_e = (top1 * wt).sum(axis=(0, 1)) / denom
+        p_e = (probs * wt).sum(axis=(0, 1)) / denom
     aux = E * jnp.sum(f_e * p_e)
     return y.reshape(B, T, d), aux

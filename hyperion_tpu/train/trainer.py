@@ -249,8 +249,10 @@ def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int,
     # changes the PARAM TREE (stacked stages), so it gets its own dir —
     # restoring a per-block tree into a stacked one fails in orbax.
     tag = f"_pipe{cfg.distributed.pipe}" if cfg.distributed.pipe > 1 else ""
-    if cfg.train.moe_experts:  # MoE is a different param tree too
-        tag += f"_moe{cfg.train.moe_experts}"
+    if cfg.train.moe_experts:  # MoE is a different param tree too, and
+        # moe_every changes WHICH blocks are sparse — same-tree restores
+        # only work when both match
+        tag += f"_moe{cfg.train.moe_experts}x{cfg.train.moe_every}"
     ckpt_dir = f"{cfg.train.base_dir}/checkpoints/{job}_{n_devices}dev{tag}"
     steps_per_epoch = min(len(batches), cfg.train.steps_per_epoch or len(batches))
     if steps_per_epoch <= 0:

@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Capture the full perf story on the real TPU chip (VERDICT r2 item 2):
+# hardware sweep, baseline fwd/bwd/opt decomposition + batch scaling,
+# compile-tier comparison, and the headline bench.py line. Every suite
+# uses the chained/host-fenced timers (utils/timing.py), so a lazy
+# backend fence yields a rejected measurement, not a fake number.
+#
+# Usage: scripts/capture_results.sh [outdir]   (default results/benchmarks)
+# Each stage is individually time-bounded so a dead tunnel cannot hang
+# the whole capture; partial results are kept.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-results/benchmarks}"
+
+probe() {
+  timeout 120 python - <<'EOF'
+import jax
+d = jax.devices()[0]
+print(f"[capture] backend={d.platform} kind={getattr(d,'device_kind','?')}")
+EOF
+}
+
+echo "[capture] probing device (120s limit)..."
+if ! probe; then
+  echo "[capture] device probe failed/timed out — tunnel down; aborting" >&2
+  exit 1
+fi
+
+run() {  # run <timeout_s> <label> <cmd...>
+  local t="$1" label="$2"; shift 2
+  echo "[capture] === $label ==="
+  timeout "$t" "$@" || echo "[capture] $label failed (rc=$?) — continuing" >&2
+}
+
+run 900 hw_explore \
+  python -m hyperion_tpu.bench.hw_explore --out "$OUT/hardware"
+run 1800 baseline \
+  python -m hyperion_tpu.bench.baseline --scaling --out "$OUT/baseline"
+run 1800 compile_bench \
+  python -m hyperion_tpu.bench.compile_bench --train-step --out "$OUT/compilation"
+run 1200 bench.py python bench.py
+
+echo "[capture] artifacts:"
+find "$OUT" -type f | sort

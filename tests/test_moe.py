@@ -67,6 +67,27 @@ class TestRouting:
         np.testing.assert_allclose(np.asarray(c2.sum((1, 2))), 1.0, atol=1e-5)
 
 
+class TestRoutingValidation:
+    def test_top_k_exceeding_experts_raises(self):
+        with pytest.raises(ValueError, match="top_k"):
+            moe_cfg(n_experts=1, top_k=2)
+
+    def test_padding_consumes_no_capacity(self):
+        """Pads must not steal slots: with capacity exactly the real
+        count, every real token survives when pads are masked out."""
+        cfg = moe_cfg(top_k=1, capacity_factor=1.0)
+        N = 16
+        # everyone wants expert 0; first half of tokens are padding
+        probs = jnp.tile(jnp.asarray([[0.97, 0.01, 0.01, 0.01]]), (N, 1))
+        valid = jnp.concatenate([jnp.zeros(8), jnp.ones(8)])
+        dispatch, combine = top_k_routing(probs, cfg, 8, valid)
+        # all 8 real tokens kept (pads would have filled the slots)
+        assert float(dispatch[8:].sum()) == 8.0
+        # pads dispatched nowhere, zero combine weight
+        assert float(dispatch[:8].sum()) == 0.0
+        assert float(combine[:8].sum()) == 0.0
+
+
 class TestMoEFFN:
     def test_output_finite_and_shaped(self):
         cfg = moe_cfg()
@@ -107,6 +128,37 @@ class TestMoEFFN:
             np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5
         )
         assert abs(float(aux) - float(aux_ref)) < 1e-5
+
+    def test_padded_tokens_pass_through_as_zero(self):
+        cfg = moe_cfg()
+        params = init_moe_params(jax.random.key(0), cfg)
+        x = jax.random.normal(jax.random.key(1), (2, 8, D), jnp.float32)
+        mask = np.ones((2, 8), np.int8)
+        mask[:, 6:] = 0
+        y, aux = moe_ffn(params, x, cfg, padding_mask=jnp.asarray(mask))
+        # pad positions produce exactly zero (residual carries them)
+        assert float(jnp.abs(y[:, 6:]).max()) == 0.0
+        assert float(jnp.abs(y[:, :6]).max()) > 0.0
+        assert np.isfinite(float(aux))
+
+    def test_grouping_keeps_dispatch_linear(self):
+        """Dispatch memory per group is [g, E, C(g)]: doubling the batch
+        doubles G, not C — total stays linear in tokens."""
+        cfg = moe_cfg()
+        assert cfg.capacity(8) == cfg.capacity(8)  # per-group capacity
+        p1 = init_moe_params(jax.random.key(0), cfg)
+        x1 = jax.random.normal(jax.random.key(1), (1, 8, D), jnp.float32)
+        x2 = jnp.concatenate([x1, x1], axis=0)  # two identical rows
+        y1, _ = moe_ffn(p1, x1, cfg)
+        y2, _ = moe_ffn(p1, x2, cfg)
+        # per-row grouping ⇒ each row routes independently: identical
+        # rows give identical outputs regardless of batch size
+        np.testing.assert_allclose(
+            np.asarray(y2[0]), np.asarray(y1[0]), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(y2[1]), np.asarray(y1[0]), atol=1e-6
+        )
 
     def test_grads_flow_to_all_experts(self):
         cfg = moe_cfg(capacity_factor=4.0)

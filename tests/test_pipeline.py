@@ -93,6 +93,7 @@ class TestGPipeForward:
 
 
 class TestGPipeBackward:
+    @pytest.mark.slow
     def test_grads_match_sequential(self, mesh_pipe, setup):
         model, variables, ids = setup
 
