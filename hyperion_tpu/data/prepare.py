@@ -88,7 +88,16 @@ def prepare(
             print(f"[prepare] loaded tokenizer from {tok_dir} "
                   f"(vocab {tok.vocab_size})")
     else:
-        train_lines = filtered.get("train") or next(iter(filtered.values()))
+        # train on the train split if it has content, else the first
+        # non-empty split — never on an empty list (a base-vocab-only
+        # tokenizer would be saved and silently poison later runs)
+        train_lines = filtered.get("train") or next(
+            (v for v in filtered.values() if v), None
+        )
+        if not train_lines:
+            raise ValueError(
+                "no non-empty lines in any split to train the tokenizer on"
+            )
         tok = train_bpe(train_lines, vocab_size=vocab_size, verbose=verbose)
         tok.save(tok_dir)
         if verbose:
@@ -188,6 +197,11 @@ def main(argv=None) -> None:
     if not raw:
         raise SystemExit("nothing to prepare: pass --raw-dir, --input, "
                          "or --cifar")
+    if all(not filter_nonempty(v) for v in raw.values()):
+        raise SystemExit(
+            "every input split is empty after dropping blank lines — "
+            "check the --raw-dir/--input paths"
+        )
 
     prepare(raw, args.base_dir, args.seq_len, args.tokenizer_dir,
             args.vocab_size)

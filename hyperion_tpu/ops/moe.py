@@ -4,11 +4,14 @@ Reference status: **absent** — SURVEY §2.2's EP row records no MoE code
 in the MI250X project; this is beyond-parity TPU headroom, written in
 the GShard/Switch einsum formulation the hardware wants:
 
-  * Routing is top-k over a fp32 router; every shape is static. Token →
-    expert assignment becomes two one-hot tensors — `dispatch`
-    [N, E, C] (bool: token n occupies slot c of expert e) and `combine`
-    (same shape, gate-weighted) — so dispatch and return are plain
-    einsums that XLA tiles onto the MXU. No gathers, no dynamic shapes.
+  * Routing is top-k over a fp32 router; every shape is static. Tokens
+    route within fixed-size GROUPS (GShard's G dimension, default one
+    group per batch row): assignment becomes two one-hot tensors per
+    group — `dispatch` [G, g, E, C] (token n of group g occupies slot c
+    of expert e) and `combine` (same shape, gate-weighted) — so
+    dispatch and return are plain einsums that XLA tiles onto the MXU,
+    with C = ceil(k·g/E)·capacity_factor PER GROUP (memory linear in
+    total tokens). No gathers, no dynamic shapes.
   * Expert weights are stacked [E, ...] and shard `P('expert')`
     (`parallel.partition` claims the leading dim, like the pipeline's
     stage leaves). The dispatched-token tensor [E, C, d] carries a

@@ -11,6 +11,7 @@
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-results/benchmarks}"
+mkdir -p "$OUT"  # partial-results contract: the summary must not error
 
 probe() {
   timeout 120 python - <<'EOF'
