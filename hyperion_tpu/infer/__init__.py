@@ -1,0 +1,7 @@
+"""Inference: autoregressive generation with KV-cache decoding."""
+
+from hyperion_tpu.infer.generate import (  # noqa: F401
+    generate,
+    generate_recompute,
+    sample_token,
+)

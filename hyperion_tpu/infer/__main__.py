@@ -1,0 +1,5 @@
+"""`python -m hyperion_tpu.infer` — generation CLI (see generate.py)."""
+
+from hyperion_tpu.infer.generate import main
+
+raise SystemExit(main())

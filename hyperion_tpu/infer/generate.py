@@ -1,0 +1,239 @@
+"""Autoregressive generation — greedy / temperature / top-k sampling.
+
+Beyond reference parity: the MI250X project trains models but never
+samples from them (no generation code anywhere — SURVEY §2). Here a
+trained checkpoint becomes a usable text generator, built the TPU way:
+
+  * **KV-cache decode** (`generate`) for models whose `__call__` takes
+    `cache`/`cache_index` (Llama — `models/llama.py:init_cache`): one
+    prefill pass writes the prompt's K/V into static [B, max_len, H, D]
+    buffers, then a `lax.scan` emits one token per tick. Every shape is
+    static; per-step attention is one [1, max_len] masked row — O(T)
+    per token.
+  * **Recompute decode** (`generate_recompute`) for any causal LM
+    (TransformerLM, MoELM): the fixed-width token buffer is re-run
+    through the full forward each step and the logit at the current
+    position is sampled. O(T²) overall but zero model changes — causal
+    attention makes future buffer positions (zeros) invisible to the
+    positions that matter.
+
+Both paths stop rows that emit `eos_id` (subsequent positions get
+`pad_id`) and are deterministic at temperature 0 (argmax).
+
+CLI: `python -m hyperion_tpu.infer.generate --prompt "..." ...` loads
+the in-tree BPE tokenizer plus a gathered-export `.npz` checkpoint
+(`checkpoint/io.py:export_gathered`, written by every trainer) and
+prints the completion — model shape is inferred from the checkpoint.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sample_token(logits: jax.Array, rng: jax.Array | None,
+                 temperature: float = 0.0, top_k: int = 0) -> jax.Array:
+    """logits [B, V] → token ids [B]. temperature 0 = greedy."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def _step_rngs(rng, n):
+    if rng is None:
+        rng = jax.random.key(0)
+    return jax.random.split(rng, n)
+
+
+def generate(
+    model: Any,
+    variables: dict,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    *,
+    eos_id: int | None = None,
+    pad_id: int = 0,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """KV-cache decoding → generated ids [B, max_new_tokens].
+
+    `prompt_ids` [B, P] must be dense (left-to-right, no padding);
+    P + max_new_tokens must fit the model's max_len."""
+    from hyperion_tpu.models.llama import init_cache
+
+    B, P = prompt_ids.shape
+    cfg = model.cfg
+    if P + max_new_tokens > cfg.max_len:
+        raise ValueError(
+            f"prompt {P} + {max_new_tokens} new tokens exceeds "
+            f"max_len {cfg.max_len}"
+        )
+    cache = init_cache(cfg, B)
+    logits, cache = model.apply(
+        variables, prompt_ids, cache=cache, cache_index=0
+    )
+    rngs = _step_rngs(rng, max_new_tokens)
+    first = sample_token(logits[:, -1], rngs[0], temperature, top_k)
+    done = jnp.zeros((B,), bool) if eos_id is None else first == eos_id
+
+    def tick(carry, rng_t):
+        cache, tok, idx, done = carry
+        logits, cache = model.apply(
+            variables, tok[:, None], cache=cache, cache_index=idx
+        )
+        nxt = sample_token(logits[:, 0], rng_t, temperature, top_k)
+        nxt = jnp.where(done, pad_id, nxt)
+        if eos_id is not None:
+            done = done | (nxt == eos_id)
+        return (cache, nxt, idx + 1, done), nxt
+
+    if max_new_tokens == 1:
+        return first[:, None]
+    (_, _, _, _), rest = jax.lax.scan(
+        tick, (cache, first, jnp.int32(P), done), rngs[1:]
+    )
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def generate_recompute(
+    model: Any,
+    variables: dict,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    *,
+    eos_id: int | None = None,
+    pad_id: int = 0,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Cache-free decoding for any causal LM (same contract as
+    `generate`): re-runs the full forward over a fixed-width buffer each
+    step. Causality makes the zero future positions invisible."""
+    B, P = prompt_ids.shape
+    width = P + max_new_tokens
+    max_len = getattr(model.cfg, "max_len", None) or getattr(
+        model.cfg, "base", model.cfg
+    ).max_len
+    if width > max_len:
+        raise ValueError(f"{width} tokens exceeds max_len {max_len}")
+    buf = jnp.zeros((B, width), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, prompt_ids.astype(jnp.int32), (0, 0))
+    rngs = _step_rngs(rng, max_new_tokens)
+
+    def tick(carry, rng_t):
+        buf, idx, done = carry
+        out = model.apply(variables, buf)
+        logits = out[0] if isinstance(out, tuple) else out  # MoE aux path
+        last = jax.vmap(lambda row, i: row[i])(logits, idx - 1)  # [B, V]
+        nxt = sample_token(last, rng_t, temperature, top_k)
+        nxt = jnp.where(done, pad_id, nxt)
+        if eos_id is not None:
+            done = done | (nxt == eos_id)
+        buf = jax.vmap(lambda row, i, t: row.at[i].set(t))(
+            buf, idx, nxt
+        )
+        return (buf, idx + 1, done), nxt
+
+    done = jnp.zeros((B,), bool)
+    (_, _, _), toks = jax.lax.scan(
+        tick, (buf, jnp.full((B,), P, jnp.int32), done), rngs
+    )
+    return toks.T
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def _infer_lm_from_npz(params: dict):
+    """Rebuild a TransformerLM whose shape matches a gathered export."""
+    from hyperion_tpu.models.transformer_lm import TransformerLM, simple_lm_config
+
+    vocab, d_model = params["tok_emb"]["embedding"].shape
+    max_len = params["pos_emb"]["embedding"].shape[0]
+    n_layers = len([k for k in params if k.startswith("block_")])
+    ff_dim = params["block_0"]["fc1"]["kernel"].shape[1]
+    n_heads = params["block_0"]["attn"]["q_proj"]["kernel"].shape[1]
+    cfg = simple_lm_config(
+        vocab_size=vocab, d_model=d_model, n_layers=n_layers,
+        ff_dim=ff_dim, n_heads=n_heads, max_len=max_len, dropout=0.0,
+    )
+    return TransformerLM(cfg)
+
+
+def _infer_llama_from_npz(params: dict, max_len: int):
+    """Rebuild a Llama whose shape matches a gathered export (max_len is
+    not recoverable from weights — RoPE has no table — so it is a CLI
+    knob)."""
+    from hyperion_tpu.models.llama import Llama, LlamaConfig
+
+    vocab, d_model = params["embed_tokens"]["embedding"].shape
+    n_layers = len([k for k in params if k.startswith("layer_")])
+    l0 = params["layer_0"]
+    _, n_heads, _ = l0["attn"]["q_proj"]["kernel"].shape
+    _, n_kv_heads, _ = l0["attn"]["k_proj"]["kernel"].shape
+    ff_dim = l0["mlp"]["gate_proj"]["kernel"].shape[1]
+    cfg = LlamaConfig(
+        vocab_size=vocab, d_model=d_model, n_layers=n_layers,
+        n_heads=n_heads, n_kv_heads=n_kv_heads, ff_dim=ff_dim,
+        max_len=max_len, remat=False,
+    )
+    return Llama(cfg)
+
+
+def model_from_npz(params: dict, max_len: int = 4096):
+    """(model, cached: bool) for a gathered export — Llama exports get
+    the KV-cache decode path, TransformerLM exports the recompute one."""
+    if "embed_tokens" in params:
+        return _infer_llama_from_npz(params, max_len), True
+    return _infer_lm_from_npz(params), False
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from hyperion_tpu.checkpoint.io import load_gathered
+    from hyperion_tpu.data.bpe import ByteBPE
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--prompt", required=True)
+    p.add_argument("--ckpt", default="data/checkpoints/language_ddp_final.npz",
+                   help="gathered-export .npz (written by the trainers)")
+    p.add_argument("--tokenizer-dir", default="data/tokenizer")
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-len", type=int, default=4096,
+                   help="context length for Llama exports (RoPE has no "
+                        "weight table to infer it from)")
+    args = p.parse_args(argv)
+
+    tok = ByteBPE.load(args.tokenizer_dir)
+    params = load_gathered(args.ckpt)
+    model, cached = model_from_npz(params, args.max_len)
+    decode = generate if cached else generate_recompute
+    ids = jnp.asarray([tok.encode(args.prompt)], jnp.int32)
+    out = decode(
+        model, {"params": params}, ids, args.max_new_tokens,
+        eos_id=tok.eos_id, pad_id=tok.eos_id,  # pads vanish in decode
+        temperature=args.temperature, top_k=args.top_k,
+        rng=jax.random.key(args.seed),
+    )
+    text = tok.decode([t for t in np.asarray(out[0]) if t != tok.eos_id])
+    print(args.prompt + text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -111,21 +111,50 @@ def rope_frequencies(head_dim: int, max_len: int, theta: float) -> jax.Array:
     return jnp.asarray(np.stack([np.cos(ang), np.sin(ang)], -1))  # [T, D/2, 2]
 
 
-def apply_rope(x: jax.Array, table: jax.Array) -> jax.Array:
-    """Rotate [B, T, H, D] by the fp32 cos/sin table's first T rows."""
+def apply_rope(x: jax.Array, table: jax.Array, offset=0) -> jax.Array:
+    """Rotate [B, T, H, D] by the fp32 cos/sin table rows
+    offset..offset+T (offset may be a traced scalar — decode steps slide
+    the window as the KV cache fills)."""
     T = x.shape[1]
-    cos = table[:T, :, 0][None, :, None, :]  # [1, T, 1, D/2]
-    sin = table[:T, :, 1][None, :, None, :]
+    rows = jax.lax.dynamic_slice_in_dim(table, offset, T, axis=0)
+    cos = rows[:, :, 0][None, :, None, :]  # [1, T, 1, D/2]
+    sin = rows[:, :, 1][None, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
     return out.astype(x.dtype)
+
+
+def _grouped_cache_attention(q, ck, cv, mask, rep):
+    """Decode attention over the KV cache without materializing
+    repeated K/V for GQA: the query's head axis folds into (kv_head,
+    group) and the group rides the einsum. q [B, T, H, D]; ck/cv
+    [B, S, Hkv, D]; mask [T, S] (True = attend)."""
+    from hyperion_tpu.ops.attention import NEG_INF
+
+    B, T, H, D = q.shape
+    Hkv = ck.shape[2]
+    qf = q.astype(jnp.float32).reshape(B, T, Hkv, rep, D)
+    scale = 1.0 / np.sqrt(D)
+    logits = jnp.einsum(
+        "btgrd,bsgd->bgrts", qf * scale, ck.astype(jnp.float32)
+    )
+    logits = jnp.where(mask[None, None, None], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bgrts,bsgd->btgrd", weights, cv.astype(jnp.float32))
+    return out.reshape(B, T, H, D).astype(q.dtype)
 
 
 class LlamaAttention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, rope_table, padding_mask):
+    def __call__(self, x, rope_table, padding_mask, cache=None, cache_index=None):
+        """Training path: cache=None → [B, T, d] out. Decode path:
+        `cache` = {'k','v': [B, max_len, Hkv, D]} with `cache_index`
+        tokens already filled → (out, updated cache); the T new
+        positions are written at cache_index and attention runs over
+        the filled prefix (dense left-to-right prompts only — no
+        padding_mask in the cached path)."""
         c = self.cfg
         dense = partial(
             nn.DenseGeneral, use_bias=False, dtype=c.compute_dtype,
@@ -134,10 +163,35 @@ class LlamaAttention(nn.Module):
         q = dense(features=(c.n_heads, c.head_dim), name="q_proj")(x)
         k = dense(features=(c.n_kv_heads, c.head_dim), name="k_proj")(x)
         v = dense(features=(c.n_kv_heads, c.head_dim), name="v_proj")(x)
-        q = apply_rope(q, rope_table)
-        k = apply_rope(k, rope_table)
-        if c.n_kv_heads != c.n_heads:  # GQA: repeat kv heads
-            rep = c.n_heads // c.n_kv_heads
+        offset = 0 if cache is None else cache_index
+        q = apply_rope(q, rope_table, offset)
+        k = apply_rope(k, rope_table, offset)
+        rep = c.n_heads // c.n_kv_heads
+
+        if cache is not None:
+            T = x.shape[1]
+            ck = jax.lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, cache_index, 0, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, cache_index, 0, 0)
+            )
+            new_cache = {"k": ck, "v": cv}
+            # causal over global positions: query cache_index+i may see
+            # cache rows 0..cache_index+i (the rest of the buffer is
+            # zeros and masked off)
+            S = ck.shape[1]
+            kv_pos = jax.lax.broadcasted_iota(jnp.int32, (T, S), 1)
+            q_pos = cache_index + jax.lax.broadcasted_iota(
+                jnp.int32, (T, S), 0
+            )
+            mask = kv_pos <= q_pos  # [T, S]
+            out = _grouped_cache_attention(q, ck, cv, mask, rep)
+            return dense(
+                features=c.d_model, axis=(-2, -1), name="o_proj"
+            )(out), new_cache
+
+        if rep != 1:  # GQA: repeat kv heads
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
         out = dot_product_attention(
@@ -165,20 +219,50 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, rope_table, padding_mask):
+    def __call__(self, x, rope_table, padding_mask, cache=None, cache_index=None):
         c = self.cfg
         h = RMSNorm(c.norm_eps, c.compute_dtype, c.norm_impl, name="input_norm")(x)
-        x = x + LlamaAttention(c, name="attn")(h, rope_table, padding_mask)
+        attn = LlamaAttention(c, name="attn")
+        if cache is not None:
+            a, cache = attn(h, rope_table, None, cache, cache_index)
+        else:
+            a = attn(h, rope_table, padding_mask)
+        x = x + a
         h = RMSNorm(c.norm_eps, c.compute_dtype, c.norm_impl, name="post_attn_norm")(x)
-        return x + LlamaMLP(c, name="mlp")(h)
+        x = x + LlamaMLP(c, name="mlp")(h)
+        return x if cache is None else (x, cache)
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None,
+               dtype=None) -> list[dict]:
+    """Per-layer KV cache buffers for incremental decoding."""
+    max_len = max_len or cfg.max_len
+    if max_len > cfg.max_len:
+        # the rope table only has cfg.max_len rows; a longer cache would
+        # silently clamp the dynamic slice and corrupt rotations
+        raise ValueError(
+            f"cache max_len {max_len} exceeds model max_len {cfg.max_len}"
+        )
+    dtype = dtype or cfg.compute_dtype
+    shape = (batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return [
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for _ in range(cfg.n_layers)
+    ]
 
 
 class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, padding_mask=None, deterministic: bool = True):
-        """input_ids int32 [B, T] → logits fp32 [B, T, vocab]."""
+    def __call__(self, input_ids, padding_mask=None, deterministic: bool = True,
+                 cache=None, cache_index=None):
+        """input_ids int32 [B, T] → logits fp32 [B, T, vocab].
+
+        Decode path: pass `cache` (from `init_cache`) and `cache_index`
+        (tokens already filled) → (logits, updated cache). Used for both
+        prefill (T = prompt length, cache_index 0) and single-token
+        steps (T = 1)."""
         c = self.cfg
         x = nn.Embed(
             c.vocab_size, c.d_model, dtype=c.compute_dtype,
@@ -186,18 +270,25 @@ class Llama(nn.Module):
         )(input_ids)
         rope = rope_frequencies(c.head_dim, c.max_len, c.rope_theta)
         block = LlamaBlock
-        if c.remat_policy != "none":
+        if cache is None and c.remat_policy != "none":
             from hyperion_tpu.precision.remat import REMAT_POLICIES
 
             block = nn.remat(LlamaBlock, policy=REMAT_POLICIES[c.remat_policy])
+        new_cache = []
         for i in range(c.n_layers):
-            x = block(c, name=f"layer_{i}")(x, rope, padding_mask)
+            blk = block(c, name=f"layer_{i}")
+            if cache is None:
+                x = blk(x, rope, padding_mask)
+            else:
+                x, layer_cache = blk(x, rope, None, cache[i], cache_index)
+                new_cache.append(layer_cache)
         x = RMSNorm(c.norm_eps, c.compute_dtype, c.norm_impl, name="final_norm")(x)
         logits = nn.Dense(
             c.vocab_size, use_bias=False, dtype=c.compute_dtype,
             kernel_init=nn.initializers.normal(0.02), name="lm_head",
         )(x)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        return logits if cache is None else (logits, new_cache)
 
     def init_params(self, rng: jax.Array, batch: int = 1, seq: int | None = None):
         ids = jnp.zeros((batch, seq or min(self.cfg.max_len, 128)), jnp.int32)
