@@ -183,7 +183,7 @@ def train_step_rows(dtype: str, seq: int = 1024, batch: int = 4) -> list[dict]:
         def step(params, opt_state, ids):
             def loss_fn(p):
                 logits = model.apply({"params": p}, ids)
-                return next_token_loss(logits, ids)
+                return next_token_loss(logits, ids, impl=impl)
 
             grads = jax.grad(loss_fn)(params)
             updates, opt_state = tx.update(grads, opt_state, params)

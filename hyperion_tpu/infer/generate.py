@@ -40,6 +40,8 @@ def sample_token(logits: jax.Array, rng: jax.Array | None,
     """logits [B, V] → token ids [B]. temperature 0 = greedy."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if rng is None:
+        raise ValueError("temperature > 0 sampling needs an rng key")
     logits = logits / temperature
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
@@ -78,7 +80,10 @@ def generate(
             f"prompt {P} + {max_new_tokens} new tokens exceeds "
             f"max_len {cfg.max_len}"
         )
-    cache = init_cache(cfg, B)
+    # size the cache to the tokens actually produced — a cfg.max_len
+    # buffer would cost max_len/(P+new) times the memory and per-step
+    # attention FLOPs for nothing (positions are global either way)
+    cache = init_cache(cfg, B, max_len=P + max_new_tokens)
     logits, cache = model.apply(
         variables, prompt_ids, cache=cache, cache_index=0
     )
@@ -193,9 +198,21 @@ def _infer_llama_from_npz(params: dict, max_len: int):
 
 def model_from_npz(params: dict, max_len: int = 4096):
     """(model, cached: bool) for a gathered export — Llama exports get
-    the KV-cache decode path, TransformerLM exports the recompute one."""
+    the KV-cache decode path, TransformerLM exports the recompute one.
+    MoE/pipeline exports are rejected with a clear message rather than
+    rebuilt wrong."""
     if "embed_tokens" in params:
         return _infer_llama_from_npz(params, max_len), True
+    if any(k.startswith("moe_block_") for k in params) or "stages" in params:
+        raise ValueError(
+            "MoE/pipeline checkpoints are not supported by the generation "
+            "CLI yet — export a dense TransformerLM or Llama checkpoint"
+        )
+    if "tok_emb" not in params:
+        raise ValueError(
+            f"unrecognized checkpoint layout (top-level keys: "
+            f"{sorted(params)[:6]}...)"
+        )
     return _infer_lm_from_npz(params), False
 
 

@@ -1,0 +1,193 @@
+"""Fused softmax cross-entropy — the loss-side Pallas kernel.
+
+Role in the stack: third member of the `jit+pallas` tier (with flash
+attention and the fused norms — the reference's max-autotune analogue,
+`compilation_optimization.py:96-103`). For the GPT-2-vocab LMs the CE
+over [N, 50257] logits is the largest non-matmul op in the train step;
+XLA computes it as separate max / exp-sum / gather passes over HBM,
+each touching the full logits array.
+
+Kernel shape:
+
+  * Forward: grid (row tiles, vocab tiles) with the vocab axis
+    innermost and "arbitrary" — one streaming pass computes the online
+    logsumexp (running max + rescaled sum, flash-attention style) AND
+    picks out each row's target logit via an iota==target compare, so
+    the [N, V] array is read exactly once. Outputs per-row loss
+    (lse - target_logit) and the lse residual.
+  * Backward: d_logits = (softmax - onehot(target)) * g, tile-by-tile
+    from the saved lse — again one pass, nothing materialized beyond
+    the output itself.
+
+Rows/vocab are padded to tile multiples with NEG_INF columns (which
+change neither lse nor gradients) and zero rows (sliced off). On
+non-TPU backends the kernels run in interpret mode, so the CPU test
+suite exercises them.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hyperion_tpu.ops.attention import NEG_INF
+
+DEFAULT_BLOCK_N = 256
+DEFAULT_BLOCK_V = 2048
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _compiler_params():
+    if _interpret():
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary"),
+    )
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(logits_ref, tgt_ref, loss_ref, lse_ref, m_s, l_s, t_s,
+                *, block_v: int, n_v: int):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        t_s[...] = jnp.zeros_like(t_s)
+
+    tile = logits_ref[...].astype(jnp.float32)       # [bn, bv]
+    m_prev, l_prev = m_s[...], l_s[...]
+    m_new = jnp.maximum(m_prev, tile.max(axis=-1))
+    l_s[...] = l_prev * jnp.exp(m_prev - m_new) + jnp.sum(
+        jnp.exp(tile - m_new[:, None]), axis=-1
+    )
+    m_s[...] = m_new
+
+    # target logit: each row's target falls in exactly one vocab tile
+    col = j * block_v + jax.lax.broadcasted_iota(jnp.int32, tile.shape, 1)
+    hit = col == tgt_ref[...][:, None]
+    t_s[...] = t_s[...] + jnp.sum(jnp.where(hit, tile, 0.0), axis=-1)
+
+    @pl.when(j == n_v - 1)
+    def _finalize():
+        lse = m_s[...] + jnp.log(jnp.maximum(l_s[...], 1e-37))
+        lse_ref[...] = lse
+        loss_ref[...] = lse - t_s[...]
+
+
+# ---------------------------------------------------------------- backward
+
+
+def _bwd_kernel(logits_ref, tgt_ref, lse_ref, g_ref, dlogits_ref,
+                *, block_v: int):
+    j = pl.program_id(1)
+    tile = logits_ref[...].astype(jnp.float32)
+    p = jnp.exp(tile - lse_ref[...][:, None])
+    col = j * block_v + jax.lax.broadcasted_iota(jnp.int32, tile.shape, 1)
+    onehot = (col == tgt_ref[...][:, None]).astype(jnp.float32)
+    dlogits_ref[...] = (
+        (p - onehot) * g_ref[...][:, None]
+    ).astype(dlogits_ref.dtype)
+
+
+# ---------------------------------------------------------------- public
+
+
+def _pad(logits, targets, block_n, block_v):
+    N, V = logits.shape
+    pn = (-N) % block_n
+    pv = (-V) % block_v
+    if pv:
+        logits = jnp.pad(logits, ((0, 0), (0, pv)),
+                         constant_values=NEG_INF)
+    if pn:
+        logits = jnp.pad(logits, ((0, pn), (0, 0)))
+        targets = jnp.pad(targets, (0, pn))
+    return logits, targets
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_softmax_xent(logits, targets, block_n=DEFAULT_BLOCK_N,
+                       block_v=DEFAULT_BLOCK_V):
+    """Per-row cross entropy: [N, V] float logits x [N] int targets →
+    [N] fp32 losses (lse - target logit) — drop-in for
+    `optax.softmax_cross_entropy_with_integer_labels`."""
+    loss, _ = _fwd(logits, targets, block_n, block_v)
+    return loss
+
+
+def _run_forward(logits, targets, block_n, block_v):
+    N = logits.shape[0]
+    lp, tp = _pad(logits, targets, block_n, block_v)
+    Np, Vp = lp.shape
+    bn = min(block_n, Np)
+    bv = min(block_v, Vp)
+    n_v = Vp // bv
+    loss, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_v=bv, n_v=n_v),
+        grid=(Np // bn, n_v),
+        in_specs=[
+            pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
+            pl.BlockSpec((bn,), lambda i, j: (i,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn,), lambda i, j: (i,)),
+            pl.BlockSpec((bn,), lambda i, j: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Np,), jnp.float32),
+            jax.ShapeDtypeStruct((Np,), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bn,), jnp.float32),
+            pltpu.VMEM((bn,), jnp.float32),
+            pltpu.VMEM((bn,), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(lp, tp.astype(jnp.int32))
+    return loss[:N], lse[:N]
+
+
+def _fwd(logits, targets, block_n, block_v):
+    loss, lse = _run_forward(logits, targets, block_n, block_v)
+    return loss, (logits, targets, lse)
+
+
+def _bwd(block_n, block_v, residuals, g):
+    logits, targets, lse = residuals
+    N, V = logits.shape
+    lp, tp = _pad(logits, targets, block_n, block_v)
+    Np, Vp = lp.shape
+    bn = min(block_n, Np)
+    bv = min(block_v, Vp)
+    lse_p = jnp.pad(lse, (0, Np - N))
+    g_p = jnp.pad(g.astype(jnp.float32), (0, Np - N))
+    dlogits = pl.pallas_call(
+        functools.partial(_bwd_kernel, block_v=bv),
+        grid=(Np // bn, Vp // bv),
+        in_specs=[
+            pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
+            pl.BlockSpec((bn,), lambda i, j: (i,)),
+            pl.BlockSpec((bn,), lambda i, j: (i,)),
+            pl.BlockSpec((bn,), lambda i, j: (i,)),
+        ],
+        out_specs=pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((Np, Vp), logits.dtype),
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(lp, tp.astype(jnp.int32), lse_p, g_p)
+    return dlogits[:N, :V], None
+
+
+fused_softmax_xent.defvjp(_fwd, _bwd)

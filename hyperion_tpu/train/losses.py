@@ -24,16 +24,26 @@ def next_token_loss(
     logits: jax.Array,      # [B, T, V] fp32
     input_ids: jax.Array,   # [B, T] int32
     attention_mask: jax.Array | None = None,  # [B, T] 1=real
+    impl: str = "xla",      # xla | pallas (ops.pallas.fused_ce)
 ) -> jax.Array:
     """Causal-LM loss with the reference's shift-and-ignore-pad semantics.
 
     The model sees positions 0..T-1 and predicts 1..T; position t's logits
     are scored against token t+1. A target is counted only when it is a
-    real (non-pad) token.
+    real (non-pad) token. impl="pallas" streams the vocab axis through
+    the fused logsumexp+gather kernel (one HBM pass over the logits).
     """
     targets = input_ids[:, 1:]
     pred = logits[:, :-1].astype(jnp.float32)
-    per_tok = optax.softmax_cross_entropy_with_integer_labels(pred, targets)
+    if impl == "pallas":
+        from hyperion_tpu.ops.pallas.fused_ce import fused_softmax_xent
+
+        B, Tm1, V = pred.shape
+        per_tok = fused_softmax_xent(
+            pred.reshape(B * Tm1, V), targets.reshape(B * Tm1)
+        ).reshape(B, Tm1)
+    else:
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(pred, targets)
     if attention_mask is None:
         return per_tok.mean()
     w = attention_mask[:, 1:].astype(jnp.float32)

@@ -208,16 +208,24 @@ def _lm_validation(cfg: Config, splits, mesh, sharding, loss_fn,
 
 
 def _tier_impls(cfg: Config) -> dict[str, str]:
-    """`optimization.compile_tier` → model kernel-impl kwargs. The
-    "jit+pallas" tier (the reference's max-autotune analogue,
+    """`optimization.compile_tier` → per-op impl selections, in ONE
+    place. The "jit+pallas" tier (the reference's max-autotune analogue,
     `compilation_optimization.py:96-103`) swaps in the in-tree Pallas
-    flash-attention and fused-norm kernels with one flag."""
+    flash-attention, fused-norm, and fused-CE kernels with one flag.
+    `attention_impl`/`norm_impl` are model-config kwargs; `loss_impl`
+    feeds `next_token_loss` (strip it before spreading into a model
+    config — `_model_impls`)."""
     pallas = cfg.optimization.compile_tier in ("jit+pallas", "pallas")
     impl = "pallas" if pallas else "xla"
     attn = cfg.optimization.attention_impl or impl
     if attn == "ulysses" and pallas:
         attn = "ulysses:pallas"  # flash kernel as the local attention
-    return {"attention_impl": attn, "norm_impl": impl}
+    return {"attention_impl": attn, "norm_impl": impl, "loss_impl": impl}
+
+
+def _model_impls(tier_impl: dict) -> dict:
+    """The subset of `_tier_impls` that model configs accept."""
+    return {k: tier_impl[k] for k in ("attention_impl", "norm_impl")}
 
 
 def _build_mesh(cfg: Config):
@@ -308,7 +316,7 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
             dropout=0.0,
             remat=cfg.optimization.remat,
             dtype=jnp.dtype(policy.compute_dtype).name,
-            **tier_impl,
+            **_model_impls(tier_impl),
         )
         if base.n_layers % pipe:
             # smallest layer count that fills every stage (the toy LM's 2
@@ -345,7 +353,7 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
             dropout=0.1,
             remat=cfg.optimization.remat,
             dtype=jnp.dtype(policy.compute_dtype).name,
-            **tier_impl,
+            **_model_impls(tier_impl),
         )
         model = MoELM(MoELMConfig(
             base=base,
@@ -364,7 +372,7 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
             dropout=0.1,
             remat=cfg.optimization.remat,
             dtype=jnp.dtype(policy.compute_dtype).name,
-            **tier_impl,
+            **_model_impls(tier_impl),
         ))
     optimizer = make_optimizer(
         cfg.train.learning_rate, cfg.train.weight_decay,
@@ -396,7 +404,8 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
             )
             aux = 0.0
         loss = next_token_loss(
-            logits, batch["input_ids"], batch["attention_mask"]
+            logits, batch["input_ids"], batch["attention_mask"],
+            impl=tier_impl["loss_impl"],
         ) + aux
         return loss, ({"loss": loss}, batch_stats)
 
@@ -542,12 +551,18 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
     # no remat so the baseline is measurable (the CLI defaults llama to
     # 'full' since 7B doesn't fit un-rematerialized on a single chip)
     llcfg = (
-        llama_tiny_config(remat=cfg.optimization.remat, **tier_impl)
+        llama_tiny_config(
+            # the tiny config's default 64-token context must stretch to
+            # the data's window or RoPE runs out of table rows
+            max_len=max(cfg.train.seq_len, 64),
+            remat=cfg.optimization.remat,
+            **_model_impls(tier_impl),
+        )
         if cfg.train.model == "llama_tiny"
         else llama2_7b_config(
             max_len=max(cfg.train.seq_len, 128),
             remat=cfg.optimization.remat,
-            **tier_impl,
+            **_model_impls(tier_impl),
         )
     )
     model = Llama(llcfg)
@@ -630,7 +645,10 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
             {"params": eff}, batch["input_ids"],
             padding_mask=batch["attention_mask"],
         )
-        loss = next_token_loss(logits, batch["input_ids"], batch["attention_mask"])
+        loss = next_token_loss(
+            logits, batch["input_ids"], batch["attention_mask"],
+            impl=tier_impl["loss_impl"],
+        )
         return loss, ({"loss": loss}, batch_stats)
 
     train_step = make_train_step(

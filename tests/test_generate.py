@@ -39,6 +39,7 @@ class TestKVCache:
             np.asarray(pre), np.asarray(full), atol=2e-5, rtol=2e-4
         )
 
+    @pytest.mark.slow
     def test_stepwise_decode_matches_full_forward(self, llama, prompt):
         """Teacher-forced: feeding gold tokens one at a time through the
         cache must reproduce the full forward's logits per position."""
