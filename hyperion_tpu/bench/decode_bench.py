@@ -1,0 +1,131 @@
+"""Decode benchmark: prefill latency + per-token generation throughput.
+
+Beyond the reference's benchmark surface (it never samples — SURVEY
+§2): measures the KV-cache decode path `infer.generate` uses, per model
+size. The decode step threads (cache, token, index) through
+`utils.timing.time_chained` — each step's cache update and argmax feed
+the next step, so the measurement is data-dependent end to end and the
+lazy-fence failure mode round 2 exposed cannot touch it. Prefill is a
+single host-fenced forward.
+
+CLI: `python -m hyperion_tpu.bench.decode_bench [--models tiny mid]
+[--batch 8] [--prompt-len 128] [--out dir]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hyperion_tpu.models.llama import Llama, init_cache, llama_tiny_config
+from hyperion_tpu.utils.memory import peak_bytes_in_use
+from hyperion_tpu.utils.timing import time_chained, time_fn
+
+# "mid" ≈ a 1B-shaped model: big enough that decode is HBM-bound like
+# production decoding, small enough to init on one chip quickly
+MODEL_SPECS = {
+    "tiny": dict(max_len=512),
+    "mid": dict(
+        vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
+        n_kv_heads=16, ff_dim=5504, max_len=2048, dtype="bfloat16",
+    ),
+}
+
+
+def benchmark_decode(
+    name: str, batch: int = 8, prompt_len: int = 128, decode_len: int = 64,
+) -> dict:
+    cfg = llama_tiny_config(**MODEL_SPECS[name])  # tiny base + overrides
+    model = Llama(cfg)
+    params = jax.jit(
+        lambda r: model.init_params(r, seq=min(8, cfg.max_len))
+    )(jax.random.key(0))
+    variables = {"params": params}
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(1, cfg.vocab_size, (batch, prompt_len)),
+        jnp.int32,
+    )
+    if prompt_len + decode_len > cfg.max_len:
+        raise ValueError(
+            f"{prompt_len + decode_len} tokens > max_len {cfg.max_len}"
+        )
+    # cache sized to the FULL context: time_chained may auto-grow the
+    # chain length for fast models, and every decoded position must stay
+    # inside the cache and rope table (growth is capped to match below)
+    prefill = jax.jit(
+        lambda ids: model.apply(
+            variables, ids, cache=init_cache(cfg, batch),
+            cache_index=0,
+        )
+    )
+    t_prefill = time_fn(prefill, ids, warmup=2, iters=5)
+    logits, cache = prefill(ids)
+    tok0 = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+
+    def decode_step(cache, tok, idx):
+        logits, cache = model.apply(
+            variables, tok[:, None], cache=cache, cache_index=idx
+        )
+        nxt = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+        return cache, nxt, idx + 1
+
+    budget = cfg.max_len - prompt_len - 1  # longest legal chain
+    k2 = min(24, budget)
+    t = time_chained(
+        decode_step, cache, tok0, jnp.int32(prompt_len),
+        k1=max(2, k2 // 3), k2=k2, n_thread=3, max_k2=budget,
+    )
+    return {
+        "model": name,
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "prefill_ms": round(t_prefill.median_ms, 3),
+        "decode_ms_per_token": round(t.per_iter_ms, 4),
+        "decode_tokens_per_s": round(t.throughput(batch), 1),
+        "dispatch_overhead_ms": round(t.overhead_ms, 2),
+        "peak_memory_mb": round(peak_bytes_in_use() / 1e6, 2),
+        "params_m": round(
+            sum(x.size for x in jax.tree.leaves(params)) / 1e6, 1
+        ),
+    }
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--models", nargs="*", default=["tiny", "mid"],
+                   choices=sorted(MODEL_SPECS))
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--decode-len", type=int, default=64)
+    p.add_argument("--out", default="results/benchmarks/decode")
+    args = p.parse_args(argv)
+
+    rows = []
+    for name in args.models:
+        try:
+            r = benchmark_decode(
+                name, args.batch, args.prompt_len, args.decode_len
+            )
+        except Exception as e:  # one model's OOM must not kill the sweep
+            print(f"[decode_bench] {name} failed: {str(e).splitlines()[0]}")
+            continue
+        rows.append(r)
+        print(f"[decode_bench] {json.dumps(r)}")
+    if rows:
+        out = Path(args.out)
+        out.mkdir(parents=True, exist_ok=True)
+        with (out / "decode_benchmarks.csv").open("w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(rows[0]))
+            w.writeheader()
+            w.writerows(rows)
+        print(f"[decode_bench] results in {out}/")
+
+
+if __name__ == "__main__":
+    main()

@@ -133,7 +133,7 @@ def _run_forward(logits, targets, block_n, block_v):
     bn = min(block_n, Np)
     bv = min(block_v, Vp)
     n_v = Vp // bv
-    loss, lse = pl.pallas_call(
+    loss, lse_p = pl.pallas_call(
         functools.partial(_fwd_kernel, block_v=bv, n_v=n_v),
         grid=(Np // bn, n_v),
         in_specs=[
@@ -156,22 +156,29 @@ def _run_forward(logits, targets, block_n, block_v):
         compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(lp, tp.astype(jnp.int32))
-    return loss[:N], lse[:N]
+    # residuals keep the PADDED arrays so backward re-pads nothing —
+    # padding the [N, V] logits twice would add a full extra HBM copy
+    # of the step's largest tensor
+    return loss[:N], (lp, tp, lse_p, (N, logits.shape[1]))
 
 
 def _fwd(logits, targets, block_n, block_v):
-    loss, lse = _run_forward(logits, targets, block_n, block_v)
-    return loss, (logits, targets, lse)
+    loss, residuals = _run_forward(logits, targets, block_n, block_v)
+    return loss, residuals
+
+
+def fused_softmax_xent_fwd_only(logits, targets, block_n=DEFAULT_BLOCK_N,
+                                block_v=DEFAULT_BLOCK_V):
+    """Forward without residual retention (eval paths)."""
+    loss, _ = _run_forward(logits, targets, block_n, block_v)
+    return loss
 
 
 def _bwd(block_n, block_v, residuals, g):
-    logits, targets, lse = residuals
-    N, V = logits.shape
-    lp, tp = _pad(logits, targets, block_n, block_v)
+    lp, tp, lse_p, (N, V) = residuals
     Np, Vp = lp.shape
     bn = min(block_n, Np)
     bv = min(block_v, Vp)
-    lse_p = jnp.pad(lse, (0, Np - N))
     g_p = jnp.pad(g.astype(jnp.float32), (0, Np - N))
     dlogits = pl.pallas_call(
         functools.partial(_bwd_kernel, block_v=bv),
@@ -183,7 +190,7 @@ def _bwd(block_n, block_v, residuals, g):
             pl.BlockSpec((bn,), lambda i, j: (i,)),
         ],
         out_specs=pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((Np, Vp), logits.dtype),
+        out_shape=jax.ShapeDtypeStruct((Np, Vp), lp.dtype),
         compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(lp, tp.astype(jnp.int32), lse_p, g_p)

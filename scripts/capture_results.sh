@@ -39,6 +39,8 @@ run 1800 baseline \
   python -m hyperion_tpu.bench.baseline --scaling --out "$OUT/baseline"
 run 1800 compile_bench \
   python -m hyperion_tpu.bench.compile_bench --train-step --out "$OUT/compilation"
+run 900 decode_bench \
+  python -m hyperion_tpu.bench.decode_bench --out "$OUT/decode"
 run 1200 bench.py python bench.py
 
 echo "[capture] artifacts:"
