@@ -162,6 +162,7 @@ class TestMoEFFN:
             np.asarray(y2[1]), np.asarray(y1[0]), atol=1e-6
         )
 
+    @pytest.mark.slow
     def test_grads_flow_to_all_experts(self):
         cfg = moe_cfg(capacity_factor=4.0)
         params = init_moe_params(jax.random.key(0), cfg)
@@ -195,6 +196,7 @@ class TestMoELM:
         assert logits.dtype == jnp.float32
         assert float(aux) > 0  # one MoE layer sowed its loss
 
+    @pytest.mark.slow
     def test_remat_matches_and_grads(self):
         """cfg.base.remat must reach both dense and sparse blocks (the
         TransformerLM scaffold is shared; regression for the dropped
