@@ -10,16 +10,22 @@ designed TPU-first, not ported.
 
 Layering (mirrors SURVEY.md §1, re-expressed for TPU):
 
-  runtime/    mesh + jax.distributed bootstrap + comm_check   (ref L1)
+  runtime/    mesh (6 axes) + dist bootstrap + C++ host coord (ref L1)
+  native/     C++ extensions: host coordinator, recordio      (ref L0)
   precision/  bf16 policies + rematerialization               (ref L2)
-  data/       tokenized-text + CIFAR pipelines, host sharding (ref L3)
-  models/     TransformerLM, ResNet, ViT, Llama-2, LoRA       (ref L3)
-  parallel/   dp / fsdp / tp partition rules, ring attention  (ref L4)
+  data/       BPE tokenizer, text/CIFAR pipelines, recordio,
+              host-sharded batching                           (ref L3)
+  models/     TransformerLM, ResNet, ViT, Llama-2, LoRA,
+              PipelinedLM, MoELM                              (ref L3)
+  parallel/   dp/fsdp/tp partition rules + gpipe pipeline     (ref L4)
+  ops/        attention (xla/pallas/ring/ulysses), MoE; Pallas
+              kernels: flash attention fwd+bwd, fused norms,
+              fused cross-entropy                      (ref L0 analogue)
   train/      jitted train steps + epoch drivers + trainers   (ref L5)
   checkpoint/ orbax-backed sharded + gathered save/restore    (ref §5.4)
-  metrics/    CSV logger + scaling report                     (ref L6)
-  bench/      hw_explore, baseline, compile_bench             (ref L6)
-  kernels/    Pallas fused attention / layernorm              (ref L0 analogue)
+  infer/      KV-cache + recompute generation, sampling CLI   (beyond ref)
+  metrics/    CSV logger + scaling report + plots             (ref L6)
+  bench/      hw_explore, baseline, compile, scaling, decode  (ref L6)
   cli/        launcher with the reference CLI surface         (ref L7)
 """
 

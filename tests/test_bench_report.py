@@ -120,3 +120,14 @@ class TestCliParser:
         cfg = make_config(args, "language_ddp")
         assert (cfg.distributed.data, cfg.distributed.fsdp,
                 cfg.distributed.model, cfg.distributed.seq) == (2, 2, 2, 1)
+
+
+class TestDecodeBench:
+    @pytest.mark.slow
+    def test_tiny_decode_row(self, tmp_path):
+        from hyperion_tpu.bench.decode_bench import benchmark_decode
+
+        row = benchmark_decode("tiny", batch=2, prompt_len=16, decode_len=8)
+        assert row["decode_tokens_per_s"] > 0
+        assert row["prefill_ms"] > 0
+        assert row["params_m"] > 0
