@@ -76,10 +76,18 @@ def benchmark_decode(
         return cache, nxt, idx + 1
 
     budget = cfg.max_len - prompt_len - 1  # longest legal chain
-    k2 = min(24, budget)
+    if budget < 2:
+        raise ValueError(
+            f"prompt_len {prompt_len} leaves a {budget}-step decode "
+            f"budget in max_len {cfg.max_len} — shorten the prompt"
+        )
+    # decode_len sets the measured chain; auto-growth (fast models under
+    # timer resolution) may extend it, but never past the context
+    k2 = max(2, min(decode_len, budget))
+    k1 = max(1, min(k2 - 1, k2 // 3))
     t = time_chained(
         decode_step, cache, tok0, jnp.int32(prompt_len),
-        k1=max(2, k2 // 3), k2=k2, n_thread=3, max_k2=budget,
+        k1=k1, k2=k2, n_thread=3, max_k2=budget,
     )
     return {
         "model": name,

@@ -31,7 +31,9 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from hyperion_tpu.models.transformer_lm import Block, TransformerLMConfig
+from hyperion_tpu.models.transformer_lm import (
+    Block, TransformerLMConfig, remat_block_cls,
+)
 from hyperion_tpu.parallel.pipeline import gpipe_apply
 from hyperion_tpu.runtime.mesh import AxisName, active_mesh
 
@@ -99,11 +101,13 @@ class PipelinedLM:
     # -- forward ------------------------------------------------------
 
     def _stage_fn(self, stage_params, x, pad):
-        """Apply this stage's layers_per_stage blocks sequentially."""
+        """Apply this stage's layers_per_stage blocks sequentially,
+        honouring cfg.remat_policy (same wrapper as TransformerLM)."""
         c = self.cfg.base
+        block = remat_block_cls(c)
 
         def body(h, blk):
-            h = Block(c).apply({"params": blk}, h, pad, True)
+            h = block(c).apply({"params": blk}, h, pad, True)
             return h, None
 
         x, _ = jax.lax.scan(body, x, stage_params)
@@ -140,12 +144,21 @@ class PipelinedLM:
 
             x, _ = jax.lax.scan(run_stage, x, p["stages"])
 
-        # final norm + head in fp32 logits, matching TransformerLM
-        xf = x.astype(jnp.float32)
-        mu = xf.mean(-1, keepdims=True)
-        var = ((xf - mu) ** 2).mean(-1, keepdims=True)
-        xn = (xf - mu) * jax.lax.rsqrt(var + 1e-6)
-        xn = xn * p["ln_f"]["scale"] + p["ln_f"]["bias"]
+        # final norm + head in fp32 logits, matching TransformerLM —
+        # including the tier's norm kernel choice
+        if c.norm_impl == "pallas":
+            from hyperion_tpu.ops.pallas.fused_norm import fused_layernorm
+
+            xn = fused_layernorm(
+                x.astype(c.compute_dtype),
+                p["ln_f"]["scale"], p["ln_f"]["bias"], eps=1e-6,
+            )
+        else:
+            xf = x.astype(jnp.float32)
+            mu = xf.mean(-1, keepdims=True)
+            var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+            xn = (xf - mu) * jax.lax.rsqrt(var + 1e-6)
+            xn = xn * p["ln_f"]["scale"] + p["ln_f"]["bias"]
         logits = xn.astype(c.compute_dtype) @ p["lm_head"]["kernel"].astype(
             c.compute_dtype
         ) + p["lm_head"]["bias"].astype(c.compute_dtype)

@@ -177,8 +177,12 @@ def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig,
     mesh = active_mesh()
     ep = mesh is not None and mesh.shape[AxisName.EXPERT] > 1
     if ep:
+        # G stays sharded over the batch axes (the groups came from the
+        # sharded batch); only E moves onto the expert axis — declaring
+        # G replicated would all-gather every token group onto every
+        # data coordinate and duplicate the expert FFN data-ways
         xe = lax.with_sharding_constraint(
-            xe, NamedSharding(mesh, P(None, AxisName.EXPERT))
+            xe, NamedSharding(mesh, P(AxisName.BATCH, AxisName.EXPERT))
         )
     w = params["experts"]
     h = act(jnp.einsum("gecd,edf->gecf", xe, w["wi"].astype(x.dtype))
@@ -187,7 +191,7 @@ def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig,
     ye = ye + w["bo"].astype(x.dtype)[None, :, None, :]
     if ep:
         ye = lax.with_sharding_constraint(
-            ye, NamedSharding(mesh, P(None, AxisName.EXPERT))
+            ye, NamedSharding(mesh, P(AxisName.BATCH, AxisName.EXPERT))
         )
     y = jnp.einsum("gnec,gecd->gnd", combine, ye)
 

@@ -37,6 +37,7 @@ log = logging.getLogger(__name__)
 _INITIALIZED = False
 _HOST_COORD = None
 _HOST_RANK: int | None = None
+_NUM_PROCESSES: int | None = None  # resolved by setup() (arg or env)
 _JAX_SKIPPED = False  # host-coordination-only mode: never touch the backend
 
 # torchrun-style env compatibility: the reference reads RANK/WORLD_SIZE
@@ -67,9 +68,11 @@ def setup(
     more than one process. Safe to call unconditionally, like the
     reference's `setup(rank, world)`."""
     global _INITIALIZED, _HOST_COORD, _HOST_RANK, _JAX_SKIPPED
+    global _NUM_PROCESSES
     if _INITIALIZED:
         return
     num_processes = num_processes or int(_env_first(_ENV_NUM_PROCESSES) or 1)
+    _NUM_PROCESSES = num_processes  # args must win over env in skip-jax mode
     if num_processes <= 1:
         return  # single-host: mesh over local devices, no rendezvous
     process_id = (
@@ -188,7 +191,9 @@ def process_index() -> int:
 
 def process_count() -> int:
     if _HOST_RANK is not None and _JAX_SKIPPED:
-        return int(_env_first(_ENV_NUM_PROCESSES) or 1)
+        # setup()'s resolved value (arguments win over env — rank and
+        # world size must come from the same source)
+        return _NUM_PROCESSES or int(_env_first(_ENV_NUM_PROCESSES) or 1)
     if _single_process():
         return 1
     return jax.process_count()

@@ -241,27 +241,35 @@ def _build_mesh(cfg: Config):
     return mesh
 
 
+def _tree_tag(mesh, cfg: Config) -> str:
+    """Checkpoint-name tag for knobs that change the PARAM TREE: a pipe
+    mesh stacks stages, MoE adds sparse blocks (and moe_every changes
+    WHICH blocks) — restoring across different trees fails in orbax, so
+    each tree gets its own namespace. Reads the RESOLVED mesh size, not
+    the config field (which may be -1)."""
+    tag = f"_pipe{mesh.shape['pipe']}" if mesh.shape["pipe"] > 1 else ""
+    if cfg.train.moe_experts:
+        tag += f"_moe{cfg.train.moe_experts}x{cfg.train.moe_every}"
+    return tag
+
+
 def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int,
-                 extra_schema: tuple = ()):
+                 extra_schema: tuple = (), tree_tag: str = ""):
     """CSV logger + checkpoint-restore/resume bookkeeping shared by every
     trainer. Returns (logger, ckpt_dir, state, resume_epoch).
     `extra_schema` appends columns (e.g. val metrics) after the
-    reference-compatible base columns."""
+    reference-compatible base columns; `tree_tag` namespaces checkpoint
+    dirs per param-tree variant (`_tree_tag`)."""
     logger = CsvLogger(
         job, n_devices, cfg.train.base_dir,
         schema=SCHEMAS[job] + tuple(extra_schema),
     )
     # world-size-specific, like the reference's run ids: a 2-device run
     # must not resume a 1-device run's checkpoint (their shardings and
-    # their scaling-experiment roles differ). A pipe mesh additionally
-    # changes the PARAM TREE (stacked stages), so it gets its own dir —
-    # restoring a per-block tree into a stacked one fails in orbax.
-    tag = f"_pipe{cfg.distributed.pipe}" if cfg.distributed.pipe > 1 else ""
-    if cfg.train.moe_experts:  # MoE is a different param tree too, and
-        # moe_every changes WHICH blocks are sparse — same-tree restores
-        # only work when both match
-        tag += f"_moe{cfg.train.moe_experts}x{cfg.train.moe_every}"
-    ckpt_dir = f"{cfg.train.base_dir}/checkpoints/{job}_{n_devices}dev{tag}"
+    # their scaling-experiment roles differ)
+    ckpt_dir = (
+        f"{cfg.train.base_dir}/checkpoints/{job}_{n_devices}dev{tree_tag}"
+    )
     steps_per_epoch = min(len(batches), cfg.train.steps_per_epoch or len(batches))
     if steps_per_epoch <= 0:
         raise ValueError(
@@ -416,12 +424,28 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
         dropout=True,
     )
 
+    def eval_loss_fn(params, batch_stats, batch, rngs):
+        # pure LM loss: the router balance term belongs in the training
+        # objective, not in val_loss/val_ppl (cross-architecture CSV
+        # comparisons need like-for-like perplexity)
+        logits = model.apply(
+            {"params": params}, batch["input_ids"],
+            padding_mask=batch["attention_mask"],
+        )
+        loss = next_token_loss(
+            logits, batch["input_ids"], batch["attention_mask"],
+            impl=tier_impl["loss_impl"],
+        )
+        return loss, ({"loss": loss}, batch_stats)
+
     eval_step, val_batches, eval_cols, extra_schema = _lm_validation(
-        cfg, splits, mesh, sharding, loss_fn
+        cfg, splits, mesh, sharding,
+        eval_loss_fn if has_aux else loss_fn,
     )
 
+    tree_tag = _tree_tag(mesh, cfg)
     logger, ckpt_dir, state, resume_epoch = _prepare_run(
-        job, cfg, state, batches, n_dev, extra_schema
+        job, cfg, state, batches, n_dev, extra_schema, tree_tag
     )
     state, history = _epoch_loop(
         job=job, cfg=cfg, batches=batches, state=state, train_step=train_step,
@@ -429,8 +453,11 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
         resume_epoch=resume_epoch,
         eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
     )
+    # the final export is namespaced per param tree too: a pipe/MoE run
+    # must not clobber the dense export the generation CLI points at
     ckpt.export_gathered(
-        f"{cfg.train.base_dir}/checkpoints/{job}_final.npz", state.params
+        f"{cfg.train.base_dir}/checkpoints/{job}{tree_tag}_final.npz",
+        state.params,
     )
     return TrainResult(job, logger.run, str(logger.path), ckpt_dir, history)
 
