@@ -199,6 +199,9 @@ def main(argv=None) -> None:
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--scaling", action="store_true",
                    help="also run the batch-size scaling sweep")
+    p.add_argument("--precisions", nargs="*", default=None,
+                   help="also sweep these dtypes per model (C15's "
+                        "compare_precision_formats), e.g. float32 bfloat16")
     p.add_argument("--batch-sizes", type=int, nargs="*",
                    default=[1, 2, 4, 8, 16, 32, 64])
     p.add_argument("--out", default="results/benchmarks/baseline")
@@ -216,6 +219,27 @@ def main(argv=None) -> None:
         print(f"[baseline] {json.dumps(r)}")
     _write_csv(out / "model_benchmarks.csv", rows)
     try_plot(plot_baseline_models, rows, out / "model_benchmarks.png")
+
+    if args.precisions:
+        by_model = {r["model"]: r for r in rows}
+        prec_rows = []
+        for name in args.models:
+            for dt in args.precisions:
+                if dt == args.dtype and name in by_model:
+                    prec_rows.append(by_model[name])  # already measured
+                    continue
+                try:
+                    prec_rows.append(
+                        benchmark_model(name, args.batch_size, dt,
+                                        iters=args.iters)
+                    )
+                except Exception as e:  # noqa: BLE001 — one OOM must not
+                    # kill the rest of the capture (fp32 doubles memory)
+                    print(f"[baseline] precision {name}/{dt} failed: "
+                          f"{str(e).splitlines()[0][:120]}")
+        for r in prec_rows:
+            print(f"[baseline] precision {json.dumps(r)}")
+        _write_csv(out / "precision_comparison.csv", prec_rows)
 
     if args.scaling:
         sweeps = {}

@@ -35,8 +35,9 @@ run() {  # run <timeout_s> <label> <cmd...>
 
 run 900 hw_explore \
   python -m hyperion_tpu.bench.hw_explore --out "$OUT/hardware"
-run 1800 baseline \
-  python -m hyperion_tpu.bench.baseline --scaling --out "$OUT/baseline"
+run 2400 baseline \
+  python -m hyperion_tpu.bench.baseline --scaling \
+    --precisions float32 bfloat16 --out "$OUT/baseline"
 run 1800 compile_bench \
   python -m hyperion_tpu.bench.compile_bench --train-step --out "$OUT/compilation"
 run 900 decode_bench \
