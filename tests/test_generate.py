@@ -145,3 +145,29 @@ class TestSampleToken:
                 logits, jax.random.key(seed), temperature=1.0, top_k=2
             )
             assert int(t[0]) in (0, 1)
+
+
+class TestGenerationCLI:
+    @pytest.mark.slow
+    def test_main_end_to_end(self, tmp_path):
+        """Tokenizer training -> LM export -> CLI generation round trip."""
+        from hyperion_tpu.checkpoint.io import export_gathered
+        from hyperion_tpu.data.bpe import train_bpe
+        from hyperion_tpu.infer.generate import main
+
+        tok = train_bpe(["the quick brown fox"] * 4, vocab_size=300,
+                        verbose=False)
+        tok.save(tmp_path / "tok")
+        cfg = simple_lm_config(
+            vocab_size=tok.vocab_size, d_model=32, n_heads=4, n_layers=2,
+            ff_dim=64, max_len=32, dropout=0.0,
+        )
+        model = TransformerLM(cfg)
+        params = model.init_params(jax.random.key(0))
+        export_gathered(tmp_path / "lm.npz", params)
+        rc = main([
+            "--prompt", "the quick", "--ckpt", str(tmp_path / "lm.npz"),
+            "--tokenizer-dir", str(tmp_path / "tok"),
+            "--max-new-tokens", "4",
+        ])
+        assert rc == 0
