@@ -32,9 +32,17 @@ def _default_counts(limit: int) -> list[int]:
     return counts or [1]
 
 
+# The reference's sweep covers all four trainers (distributed_utils.py:
+# 628-650 infers the job from the run-id filename); llama scales in its
+# LoRA form and at the tiny (architecture-true) size so the simulated CPU
+# mesh can actually run it.
+SCALING_JOBS = ("language_ddp", "cifar", "language_fsdp", "llama")
+_JOB_EXTRA_FLAGS = {"llama": ("--llama_size", "tiny", "--lora")}
+
+
 def run_scaling_experiment(
     device_counts: list[int] | None = None,
-    model: str = "language_ddp",
+    models: str | list[str] = SCALING_JOBS,
     epochs: int = 3,
     base_dir: str = "data",
     steps_per_epoch: int = 20,
@@ -42,7 +50,7 @@ def run_scaling_experiment(
     batch_size: int | None = None,
     validate: bool = True,
 ) -> list[dict]:
-    """Run `model` at each device count in a fresh subprocess; report."""
+    """Run each job at each device count in a fresh subprocess; report."""
     # Only probe the real backend when the caller did not decide: with
     # simulate_on_cpu explicitly set, touching jax.devices() here would
     # block the whole sweep on an unreachable TPU tunnel.
@@ -50,33 +58,37 @@ def run_scaling_experiment(
         simulate_on_cpu = len(jax.devices()) < 2  # single chip: simulate on CPU
     limit = 8 if simulate_on_cpu else len(jax.devices())
     device_counts = device_counts or _default_counts(limit)
+    jobs = [models] if isinstance(models, str) else list(models)
 
-    for n in device_counts:
-        cmd = [
-            sys.executable, "-m", "hyperion_tpu.cli.main",
-            "--model", model, "--epochs", str(epochs),
-            "--base_dir", base_dir, "--devices", str(n),
-            "--steps-per-epoch", str(steps_per_epoch),
-        ]
-        if batch_size:
-            cmd += ["--batch_size", str(batch_size)]
-        if not validate:
-            cmd += ["--no-validate"]
-        env = dict(os.environ)
-        if simulate_on_cpu:
-            env["JAX_PLATFORMS"] = "cpu"
-            env["PALLAS_AXON_POOL_IPS"] = ""  # detach any axon TPU tunnel
-            env["XLA_FLAGS"] = (
-                env.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count={max(device_counts)}"
-            )
-        label = "simulated-cpu" if simulate_on_cpu else jax.default_backend()
-        print(f"[scaling] {n} device(s) ({label}): {' '.join(cmd[2:])}")
-        try:
-            subprocess.run(cmd, check=True, env=env)
-        except subprocess.CalledProcessError as e:
-            # one failed count must not kill the sweep (reference :826-827)
-            print(f"[scaling] run with {n} device(s) failed: {e}")
-        time.sleep(2)  # settle, as the reference did (:823)
+    for model in jobs:
+        for n in device_counts:
+            cmd = [
+                sys.executable, "-m", "hyperion_tpu.cli.main",
+                "--model", model, "--epochs", str(epochs),
+                "--base_dir", base_dir, "--devices", str(n),
+                "--steps-per-epoch", str(steps_per_epoch),
+                *_JOB_EXTRA_FLAGS.get(model, ()),
+            ]
+            if batch_size:
+                cmd += ["--batch_size", str(batch_size)]
+            if not validate:
+                cmd += ["--no-validate"]
+            env = dict(os.environ)
+            if simulate_on_cpu:
+                env["JAX_PLATFORMS"] = "cpu"
+                env["PALLAS_AXON_POOL_IPS"] = ""  # detach any axon TPU tunnel
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "")
+                    + " --xla_force_host_platform_device_count="
+                    + str(max(device_counts))
+                )
+            label = "simulated-cpu" if simulate_on_cpu else jax.default_backend()
+            print(f"[scaling] {model} x{n} ({label}): {' '.join(cmd[2:])}")
+            try:
+                subprocess.run(cmd, check=True, env=env)
+            except subprocess.CalledProcessError as e:
+                # one failed count must not kill the sweep (reference :826-827)
+                print(f"[scaling] {model} with {n} device(s) failed: {e}")
+            time.sleep(2)  # settle, as the reference did (:823)
 
     return create_scaling_report(f"{base_dir}/distributed")

@@ -62,6 +62,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="restrict to first N devices (scaling runs)")
     p.add_argument("--scaling_devices", type=int, nargs="*", default=None,
                    help="device counts for --model scaling (default 1,2,4,8 clipped)")
+    p.add_argument("--scaling_jobs", nargs="*", default=None,
+                   help="jobs for --model scaling (default: all four "
+                        "reference jobs — language_ddp cifar language_fsdp "
+                        "llama)")
     p.add_argument("--simulate-cpu", action="store_true",
                    help="scaling: force the CPU-simulated mesh without "
                         "probing real devices (never blocks on a dead "
@@ -166,10 +170,11 @@ def main(argv=None) -> int:
     dist.setup()
 
     if args.model == "scaling":
-        from hyperion_tpu.bench.scaling import run_scaling_experiment
+        from hyperion_tpu.bench.scaling import SCALING_JOBS, run_scaling_experiment
 
         run_scaling_experiment(
             device_counts=args.scaling_devices,
+            models=args.scaling_jobs or SCALING_JOBS,
             epochs=args.epochs,
             base_dir=args.base_dir,
             steps_per_epoch=args.steps_per_epoch or 20,

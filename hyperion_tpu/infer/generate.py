@@ -49,9 +49,14 @@ def sample_token(logits: jax.Array, rng: jax.Array | None,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
-def _step_rngs(rng, n):
+def _step_rngs(rng, n, temperature=0.0):
     if rng is None:
-        rng = jax.random.key(0)
+        if temperature > 0.0:
+            # honoring sample_token's contract here, where the substitute
+            # key would be made: a constant key(0) would silently sample
+            # the same trajectory on every call
+            raise ValueError("temperature > 0 sampling needs an rng key")
+        rng = jax.random.key(0)  # greedy path: keys are never consumed
     return jax.random.split(rng, n)
 
 
@@ -87,7 +92,7 @@ def generate(
     logits, cache = model.apply(
         variables, prompt_ids, cache=cache, cache_index=0
     )
-    rngs = _step_rngs(rng, max_new_tokens)
+    rngs = _step_rngs(rng, max_new_tokens, temperature)
     first = sample_token(logits[:, -1], rngs[0], temperature, top_k)
     done = jnp.zeros((B,), bool) if eos_id is None else first == eos_id
 
@@ -134,7 +139,7 @@ def generate_recompute(
         raise ValueError(f"{width} tokens exceeds max_len {max_len}")
     buf = jnp.zeros((B, width), jnp.int32)
     buf = jax.lax.dynamic_update_slice(buf, prompt_ids.astype(jnp.int32), (0, 0))
-    rngs = _step_rngs(rng, max_new_tokens)
+    rngs = _step_rngs(rng, max_new_tokens, temperature)
 
     def tick(carry, rng_t):
         buf, idx, done = carry

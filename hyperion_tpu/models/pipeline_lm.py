@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from hyperion_tpu.models.transformer_lm import (
     Block, TransformerLMConfig, remat_block_cls,
 )
-from hyperion_tpu.parallel.pipeline import gpipe_apply
+from hyperion_tpu.parallel.pipeline import gpipe_apply, gpipe_apply_layers
 from hyperion_tpu.runtime.mesh import AxisName, active_mesh
 
 
@@ -64,6 +64,23 @@ class PipelinedLM:
 
     def __init__(self, cfg: PipelineLMConfig):
         self.cfg = cfg
+        # PartitionSpec pytree for params["stages"] — set ONLY through
+        # attach_stage_specs(); None → classic whole-stage gather.
+        self.stage_specs = None
+
+    def attach_stage_specs(self, sharding) -> None:
+        """Hand the pipeline the stage leaves' actual PartitionSpecs so
+        `apply` switches to the per-layer-gather path
+        (`gpipe_apply_layers`) and FSDP's memory ceiling holds inside
+        each stage. Call right after `create_train_state`, BEFORE the
+        train step is built/traced — apply() picks its path per trace,
+        so specs attached after tracing are silently ignored by the
+        already-compiled step. Accepts the `StateSharding` (or any
+        object with `.tree.params['stages']`) returned by
+        create_train_state."""
+        self.stage_specs = jax.tree.map(
+            lambda s: s.spec, sharding.tree.params["stages"]
+        )
 
     # -- init ---------------------------------------------------------
 
@@ -113,6 +130,13 @@ class PipelinedLM:
         x, _ = jax.lax.scan(body, x, stage_params)
         return x
 
+    def _layer_fn(self, blk, x, pad):
+        """One block on fully-gathered layer params — the per-layer unit
+        `gpipe_apply_layers` gathers+checkpoints (plain Block, not the
+        remat wrapper: the pipeline's own checkpoint covers it AND the
+        gather, which a block-level wrapper could not)."""
+        return Block(self.cfg.base).apply({"params": blk}, x, pad, True)
+
     def apply(self, variables, input_ids, padding_mask=None,
               deterministic: bool = True, rngs=None):
         del deterministic, rngs  # dropout-free by construction
@@ -132,11 +156,22 @@ class PipelinedLM:
                     f"model has {self.cfg.n_stages} stages but mesh pipe "
                     f"axis is {mesh.shape[AxisName.PIPE]}"
                 )
-            x = gpipe_apply(
-                self._stage_fn, p["stages"], x, mesh,
-                n_microbatches=self.cfg.n_microbatches,
-                extras=padding_mask,  # None passes through as empty pytree
-            )
+            if self.stage_specs is not None:
+                x = gpipe_apply_layers(
+                    self._layer_fn, p["stages"], x, mesh,
+                    n_microbatches=self.cfg.n_microbatches,
+                    param_specs=self.stage_specs,
+                    extras=padding_mask,
+                    # remat in gpipe's per-layer checkpoint, which also
+                    # covers the gather; cfg.remat would double-wrap
+                    remat_layers=True,
+                )
+            else:
+                x = gpipe_apply(
+                    self._stage_fn, p["stages"], x, mesh,
+                    n_microbatches=self.cfg.n_microbatches,
+                    extras=padding_mask,  # None passes through as empty pytree
+                )
         else:
             # sequential reference path: scan stages in order
             def run_stage(h, stage_p):

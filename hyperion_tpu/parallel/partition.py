@@ -83,7 +83,8 @@ def _pad_spec(spec: P, rank: int) -> tuple:
 
 
 def _fsdp_augment(
-    entries: tuple, shape: tuple[int, ...], fsdp_size: int, min_size: int
+    entries: tuple, shape: tuple[int, ...], fsdp_size: int, min_size: int,
+    skip: tuple[int, ...] = (),
 ) -> tuple:
     """Shard the largest still-unsharded dim over the fsdp axis.
 
@@ -91,14 +92,15 @@ def _fsdp_augment(
     (distributed_utils.py:328-332) — here per-array, picking the dim that
     balances memory best. Params smaller than `min_size` stay replicated
     (the size_based_auto_wrap_policy(min_num_params=100_000) analogue,
-    distributed_utils.py:318-319).
+    distributed_utils.py:318-319). Dims in `skip` are never claimed even
+    when free (e.g. a stacked layer axis the pipeline scans over).
     """
     if fsdp_size == 1 or int(np.prod(shape)) < min_size:
         return entries
     candidates = [
         (dim, d)
         for d, (dim, e) in enumerate(zip(shape, entries))
-        if e is None and dim % fsdp_size == 0
+        if e is None and dim % fsdp_size == 0 and d not in skip
     ]
     if not candidates:
         return entries
@@ -138,8 +140,18 @@ def partition_specs(
         if (
             pipe_size > 1
             and re.match(r"(?:.*/)?stages/", path)
-            and len(shape) >= 1 and shape[0] == pipe_size
-        ):  # [S] alone is possible only for scalar layer params
+            and len(shape) >= 1
+        ):
+            if shape[0] != pipe_size:
+                # mirror the experts/ check below: a stage-count/mesh
+                # mismatch must fail here, not later as a replication
+                # memory blow-up or a gpipe shape error
+                raise ValueError(
+                    f"{path}: leading dim {shape[0]} != {pipe_size}-stage "
+                    "pipe mesh axis (stages/ leaves must stack one slice "
+                    "per pipeline stage)"
+                )
+            # [S] alone is possible only for scalar layer params
             lead = (AxisName.PIPE,) + ((None,) if len(shape) > 1 else ())
         elif (
             expert_size > 1
@@ -170,7 +182,13 @@ def partition_specs(
                         f"{path}: shape {shape} not divisible by mesh axes {bad}"
                     )
         entries = lead + entries
-        entries = _fsdp_augment(entries, shape, fsdp_size, fsdp_min_size)
+        # stages/ leaves keep dim 1 (layers-per-stage) whole: the GPipe
+        # per-layer gather scans that axis locally, so fsdp may claim
+        # any weight dim but never the layer-stacking one
+        fsdp_skip = (1,) if lead[:1] == (AxisName.PIPE,) else ()
+        entries = _fsdp_augment(
+            entries, shape, fsdp_size, fsdp_min_size, skip=fsdp_skip
+        )
         while entries and entries[-1] is None:  # canonical: P() not P(None,...)
             entries = entries[:-1]
         specs[path] = P(*entries)

@@ -69,11 +69,13 @@ def _local_gpipe(
     # scan carries must hold the same varying-axes type as the rotating
     # activations (jax 0.9 shard_map tracks vma in loop carry types):
     # stage outputs vary over `pipe` (via params) AND the batch axes
-    # (via xs), so the carry needs the union
-    vma = tuple(
-        set(jax.typeof(jax.tree.leaves(params)[0]).vma)
-        | set(jax.typeof(xs).vma)
-    )
+    # (via xs), so the carry needs the union — over EVERY param leaf,
+    # since in the fsdp-sharded layers path different leaves can vary
+    # over different axes (fsdp, model) depending on their specs
+    vma_set = set(jax.typeof(xs).vma)
+    for leaf in jax.tree.leaves(params):
+        vma_set |= set(jax.typeof(leaf).vma)
+    vma = tuple(vma_set)
     pvary = functools.partial(lax.pcast, axis_name=vma, to="varying")
     state0 = pvary(jnp.zeros(xs.shape[1:], xs.dtype))
     out0 = pvary(jnp.zeros(xs.shape, xs.dtype))
@@ -118,6 +120,7 @@ def gpipe_apply(
     extras: Any = None,
     axis_name: str = AxisName.PIPE,
     batch_axes: tuple[str, ...] | None = None,
+    param_in_specs: Any = None,
 ) -> jax.Array:
     """Run `x` through the S-stage pipeline; returns same-shape output.
 
@@ -127,11 +130,13 @@ def gpipe_apply(
     `x` is [B, ...] with B divisible by n_microbatches; leaves of
     `extras` are [B, ...] side inputs that follow their microbatch.
 
-    Memory note: the in_spec `P(axis_name)` gathers each stage's FULL
-    parameter slice (all its layers, all dims) onto its devices for the
-    duration of the step — any fsdp/model sharding of NON-stage dims is
-    undone inside the loop. Per-layer gather inside the tick (true
-    FSDP-within-stage) is future work; until then size stages to fit.
+    Memory note: the default in_spec `P(axis_name)` gathers each stage's
+    FULL parameter slice (all its layers, all dims) onto its devices for
+    the duration of the step — any fsdp/model sharding of NON-stage dims
+    is undone inside the loop. For true FSDP-within-stage use
+    `gpipe_apply_layers`, which keeps params sharded through the
+    shard_map boundary (`param_in_specs`) and gathers one layer at a
+    time inside the tick.
     """
     S = mesh.shape[axis_name]
     B = x.shape[0]
@@ -158,13 +163,122 @@ def gpipe_apply(
     extras = jax.tree.map(to_micro, extras)
 
     mb_spec = P(None, batch_axes)  # [M, mb@batch, ...]
+    param_specs = (
+        P(axis_name) if param_in_specs is None else param_in_specs
+    )
     fn = shard_map(
         functools.partial(
             _local_gpipe, stage_fn=stage_fn, axis_name=axis_name, n_micro=M
         ),
         mesh=mesh,
-        in_specs=(P(axis_name), mb_spec, jax.tree.map(lambda _: mb_spec, extras)),
+        in_specs=(param_specs, mb_spec, jax.tree.map(lambda _: mb_spec, extras)),
         out_specs=P(axis_name, None, batch_axes),  # [S@pipe, M, mb@batch, ...]
     )
     out = fn(stage_params, xs, extras)  # [S, M, mb, ...]
     return out[-1].reshape(B, *x.shape[1:])
+
+
+def _flatten_specs(specs: Any) -> list[P]:
+    return jax.tree.flatten(specs, is_leaf=lambda s: isinstance(s, P))[0]
+
+
+def _gather_plans(
+    flat_params: list, flat_specs: list[P], axis_name: str
+) -> list[tuple[tuple[int, tuple[str, ...]], ...]]:
+    """Per leaf: ((layer-local dim, mesh axes to all_gather), ...).
+
+    Leaf global layout is [S, lps, *body]; dim 0 must be the pipe axis
+    and dim 1 (the layer axis the tick scans) must be unsharded —
+    `partition_specs` guarantees both for stages/ leaves. Body dims
+    shift by 2 once the pipe shard is peeled and the layer scan indexes
+    the lps axis."""
+    plans = []
+    for leaf, spec in zip(flat_params, flat_specs):
+        entries = tuple(spec) + (None,) * (np.ndim(leaf) - len(spec))
+        if not entries or entries[0] != axis_name:
+            raise ValueError(
+                f"stage leaf spec {spec} must lead with the {axis_name!r} "
+                "axis (stacked [S, lps, ...] layout)"
+            )
+        if len(entries) > 1 and entries[1] is not None:
+            raise ValueError(
+                f"stage leaf spec {spec} shards the layer axis (dim 1) — "
+                "the per-layer pipeline scan needs it whole"
+            )
+        plan = []
+        for d, e in enumerate(entries[2:]):
+            if e is None:
+                continue
+            names = e if isinstance(e, tuple) else (e,)
+            plan.append((d, tuple(names)))
+        plans.append(tuple(plan))
+    return plans
+
+
+def gpipe_apply_layers(
+    layer_fn: Callable[[Any, jax.Array, Any], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    n_microbatches: int,
+    param_specs: Any,
+    extras: Any = None,
+    axis_name: str = AxisName.PIPE,
+    batch_axes: tuple[str, ...] | None = None,
+    remat_layers: bool = True,
+) -> jax.Array:
+    """GPipe with FSDP-within-stage: ZeRO-3 semantics inside the tick.
+
+    `layer_fn(layer_params, x_mb, extra_mb) -> y_mb` is applied to each
+    of the stage's lps layers in order. Unlike `gpipe_apply`, the stage
+    params cross the shard_map boundary STILL SHARDED per `param_specs`
+    (the same PartitionSpecs `parallel.partition` chose for the train
+    state, e.g. P('pipe', None, 'fsdp')); each tick's layer scan
+    all-gathers ONE layer's leaves along their fsdp/model-sharded dims
+    right before use, so peak gathered memory is a single layer, not the
+    whole stage. With `remat_layers` the gather+layer call sits under
+    `jax.checkpoint`: backward re-gathers instead of keeping gathered
+    buffers alive across the schedule — exactly FSDP's
+    gather-on-use/free-after-use, expressed as layout + rematerialization
+    (the gather's transpose is the grads' reduce-scatter, inserted by AD).
+    """
+    flat, treedef = jax.tree.flatten(stage_params)
+    flat_specs = _flatten_specs(param_specs)
+    if len(flat_specs) != len(flat):
+        raise ValueError(
+            f"param_specs has {len(flat_specs)} leaves, stage_params "
+            f"{len(flat)}"
+        )
+    plans = _gather_plans(flat, flat_specs, axis_name)
+
+    def apply_layer(h, layer, extra):
+        flat_layer = jax.tree.leaves(layer)
+        full = jax.tree.unflatten(treedef, [
+            _all_gather_dims(a, plan) for a, plan in zip(flat_layer, plans)
+        ])
+        return layer_fn(full, h, extra)
+
+    if remat_layers:
+        apply_layer = jax.checkpoint(apply_layer)
+
+    def stage_fn(params, x, extra):
+        # params leaves [lps, ...] (pipe dim already peeled): scan layers
+        def body(h, layer):
+            return apply_layer(h, layer, extra), None
+
+        x, _ = lax.scan(body, x, params)
+        return x
+
+    return gpipe_apply(
+        stage_fn, stage_params, x, mesh,
+        n_microbatches=n_microbatches, extras=extras, axis_name=axis_name,
+        batch_axes=batch_axes, param_in_specs=param_specs,
+    )
+
+
+def _all_gather_dims(a: jax.Array, plan: tuple) -> jax.Array:
+    for d, names in plan:
+        for ax in names:
+            a = lax.all_gather(a, ax, axis=d, tiled=True)
+    return a

@@ -29,6 +29,7 @@ from __future__ import annotations
 import datetime
 import logging
 import os
+import warnings
 
 import jax
 
@@ -153,8 +154,19 @@ def _backends_ready() -> bool:
         from jax._src import xla_bridge
 
         return xla_bridge.backends_are_initialized()
-    except Exception:  # API drift: fall back to asking jax directly
-        return True
+    except Exception:
+        # API drift: answer False so the env-declared single-process
+        # short-circuit still applies. Returning True here would route
+        # process_index() into jax.process_index(), initializing the
+        # backend and blocking on a dead TPU tunnel — the exact failure
+        # this helper exists to avoid (a warning keeps drift visible).
+        warnings.warn(
+            "xla_bridge.backends_are_initialized unavailable (jax API "
+            "drift); assuming backend not initialized",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return False
 
 
 def _single_process() -> bool:

@@ -309,9 +309,19 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
     tier_impl = _tier_impls(cfg)
     pipe = mesh.shape["pipe"]
     if pipe > 1 and cfg.train.moe_experts > 0:
+        # Deliberate exclusion, not a TODO: the pipeline stacks stage
+        # leaves as [S, lps, ...] on the pipe axis while MoE stacks
+        # expert leaves as [E, ...] on the expert axis — composing them
+        # needs [S, lps, E, ...] leaves plus a GShard dispatch/combine
+        # INSIDE the per-tick shard_map (whose all-to-all would ride the
+        # same ICI the ppermute schedule uses). Neither axis layout is
+        # wrong alone; their product is a different kernel than either,
+        # and nothing in the reference (or the bench suite) exercises it.
         raise ValueError(
-            "pipeline + MoE in one language run is not supported yet — "
-            "drop the pipe axis or moe_experts"
+            "pipeline + MoE in one language run is deliberately "
+            "unsupported: stage-stacked [S, lps, ...] and expert-stacked "
+            "[E, ...] leaves need a fused dispatch-inside-the-tick design "
+            "(see train/trainer.py) — drop the pipe axis or moe_experts"
         )
     if pipe > 1:
         # pipeline-parallel LM (beyond reference parity — SURVEY §2.2 PP
@@ -341,9 +351,9 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
             )
             if is_fsdp:
                 print(
-                    f"[{job}] note: stage params are gathered per step "
-                    "inside the pipeline loop — FSDP's memory ceiling "
-                    "does not apply to the stacked stage leaves"
+                    f"[{job}] pipe+fsdp: per-layer gather inside the "
+                    "pipeline tick (gpipe_apply_layers) — stage params "
+                    "stay fsdp-sharded; peak gathered memory is one layer"
                 )
         model = PipelinedLM(PipelineLMConfig(
             base=base,
@@ -394,6 +404,9 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
         tp_rules=TRANSFORMER_TP_RULES,
         fsdp=is_fsdp,
     )
+    if pipe > 1 and (is_fsdp or mesh.shape["model"] > 1):
+        # per-layer gather inside the tick: params stay fsdp/tp-sharded
+        model.attach_stage_specs(sharding)
 
     has_aux = hasattr(model, "apply_with_aux")  # MoE router balance loss
 
@@ -411,11 +424,15 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
                 deterministic=rngs is None, rngs=rngs,
             )
             aux = 0.0
-        loss = next_token_loss(
+        lm = next_token_loss(
             logits, batch["input_ids"], batch["attention_mask"],
             impl=tier_impl["loss_impl"],
-        ) + aux
-        return loss, ({"loss": loss}, batch_stats)
+        )
+        loss = lm + aux
+        # MoE metrics carry the pure LM term too, so the training CSV can
+        # stay like-for-like with dense runs (val_loss already is)
+        metrics = {"loss": loss, "lm_loss": lm} if has_aux else {"loss": loss}
+        return loss, (metrics, batch_stats)
 
     train_step = make_train_step(
         loss_fn, optimizer, sharding,
@@ -443,6 +460,17 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
         eval_loss_fn if has_aux else loss_fn,
     )
 
+    extra_cols = None
+    if has_aux:
+        # the `loss` column keeps the optimized objective (lm + aux); the
+        # extra columns make the split auditable per epoch
+        def extra_cols(device_metrics: list) -> dict:
+            lm = _mean_of(device_metrics, "lm_loss")
+            total = _mean_of(device_metrics, "loss")
+            return {"lm_loss": lm, "aux_loss": total - lm}
+
+        extra_schema = ("lm_loss", "aux_loss") + tuple(extra_schema)
+
     tree_tag = _tree_tag(mesh, cfg)
     logger, ckpt_dir, state, resume_epoch = _prepare_run(
         job, cfg, state, batches, n_dev, extra_schema, tree_tag
@@ -450,7 +478,7 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
     state, history = _epoch_loop(
         job=job, cfg=cfg, batches=batches, state=state, train_step=train_step,
         rng=rng, logger=logger, n_devices=n_dev, ckpt_dir=ckpt_dir,
-        resume_epoch=resume_epoch,
+        resume_epoch=resume_epoch, extra_cols=extra_cols,
         eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
     )
     # the final export is namespaced per param tree too: a pipe/MoE run

@@ -1,0 +1,119 @@
+#!/usr/bin/env bash
+# Round-4 real-chip capture (VERDICT r3 items 1-3): headline bench,
+# model-level baseline CSVs, real training runs at the reference's epoch
+# counts, the Llama-2-7B single-chip proof, compile tiers, and decode.
+#
+# Every stage is individually time-bounded AND committed the moment it
+# lands, so a tunnel that dies mid-capture still leaves whatever evidence
+# was captured in git (the round-3 failure mode: 6+h of artifacts lost to
+# an uncommitted working tree when the tunnel died).
+#
+# Usage: scripts/capture_round4.sh  (typically fired by scripts/tpu_watch.sh)
+set -u
+cd "$(dirname "$0")/.."
+OUT=results/benchmarks
+RUNS=results/tpu_runs
+mkdir -p "$OUT" "$RUNS"
+export JAX_PLATFORMS=""   # never inherit a test shell's cpu pin
+
+commit() {  # commit <msg> <paths...> — retries around concurrent commits
+  local msg="$1"; shift
+  for i in 1 2 3 4 5; do
+    git add -- "$@" >/dev/null 2>&1
+    if git diff --cached --quiet; then
+      echo "[capture] nothing to commit for: $msg"; return 0
+    fi
+    if git commit -m "$msg" >/dev/null 2>&1; then
+      echo "[capture] committed: $msg"; return 0
+    fi
+    sleep $((i * 3))
+  done
+  echo "[capture] COMMIT FAILED: $msg" >&2
+}
+
+run() {  # run <timeout_s> <label> <cmd...>
+  local t="$1" label="$2"; shift 2
+  echo "[capture] === $label ($(date -u +%FT%TZ), limit ${t}s) ==="
+  timeout "$t" "$@"
+  local rc=$?
+  [ $rc -ne 0 ] && echo "[capture] $label rc=$rc — continuing" >&2
+  return $rc
+}
+
+probe() {
+  timeout 120 python - <<'EOF'
+import jax
+d = jax.devices()[0]
+assert d.platform == "tpu", f"not a TPU: {d.platform}"
+print(f"[capture] backend={d.platform} kind={getattr(d,'device_kind','?')}")
+EOF
+}
+
+echo "[capture] probing device (120s limit)..."
+if ! probe; then
+  echo "[capture] device probe failed/timed out — tunnel down; aborting" >&2
+  exit 1
+fi
+
+# 1. Headline bench — the driver's metric, captured first in case the
+#    tunnel dies again. bench_live.json only ever holds a GOOD headline
+#    (bench.py's last_committed fallback reads it from HEAD): a failure
+#    line lands in bench_live_latest.json but never overwrites it.
+run 1500 bench.py bash -c "python bench.py | tee $OUT/bench_live_latest.json"
+python - <<'EOF'
+import json, shutil
+try:
+    doc = json.loads(open("results/benchmarks/bench_live_latest.json")
+                     .read().strip().splitlines()[-1])
+    if doc.get("value"):
+        shutil.copy("results/benchmarks/bench_live_latest.json",
+                    "results/benchmarks/bench_live.json")
+        print("[capture] headline is good; bench_live.json updated")
+    else:
+        print("[capture] headline failed/zero; bench_live.json untouched")
+except Exception as e:
+    print(f"[capture] bench_live.json not updated: {e}")
+EOF
+commit "Real-chip capture: headline bench (bf16 matmul + LM step)" "$OUT"
+
+# 2. Model-level baseline: fwd/bwd/opt decomposition, batch scaling,
+#    precision comparison for ResNet-50 / ViT-B16 / CustomTransformer (C17).
+run 3000 baseline python -m hyperion_tpu.bench.baseline --scaling \
+  --precisions float32 bfloat16 --out "$OUT/baseline"
+commit "Real-chip capture: baseline model benchmarks (C17)" "$OUT"
+
+# 3. Real training runs at the reference's epoch counts (VERDICT item 2).
+run 3600 train_language_ddp python -m hyperion_tpu.cli.main \
+  --model language_ddp --epochs 25 --base_dir "$RUNS"
+commit "Real-chip capture: language_ddp 25-epoch training run" "$RUNS"
+
+run 3600 train_cifar python -m hyperion_tpu.cli.main \
+  --model cifar --epochs 50 --base_dir "$RUNS"
+commit "Real-chip capture: cifar_ddp 50-epoch training run" "$RUNS"
+
+# 4. Llama-2-7B at size, random-init, LoRA + full remat, bs1 (VERDICT item 3).
+run 5400 llama7b_proof python -m hyperion_tpu.cli.main \
+  --model llama --llama_size 7b --lora --batch_size 1 --epochs 1 \
+  --steps-per-epoch 12 --no-validate --base_dir "$RUNS"
+commit "Real-chip capture: Llama-2-7B LoRA single-chip proof (bs1, remat full)" "$RUNS"
+
+# 5. Compile-tier comparison incl. long-seq train-step rows (C14).
+run 2400 compile_bench python -m hyperion_tpu.bench.compile_bench \
+  --train-step --out "$OUT/compilation"
+commit "Real-chip capture: compile-tier benchmark (C14)" "$OUT"
+
+# 6. Decode throughput/memory.
+run 1200 decode_bench python -m hyperion_tpu.bench.decode_bench --out "$OUT/decode"
+commit "Real-chip capture: decode benchmark" "$OUT"
+
+# 7. Hardware sweep re-capture with the folded-rescale chain (MFU tuning).
+run 1200 hw_explore python -m hyperion_tpu.bench.hw_explore --out "$OUT/hardware"
+commit "Real-chip capture: hardware sweep (tuned matmul chain)" "$OUT"
+
+# 8. Mid-size Llama LoRA convergence run.
+run 2400 llama_tiny_lora python -m hyperion_tpu.cli.main \
+  --model llama --llama_size tiny --lora --epochs 3 --base_dir "$RUNS"
+commit "Real-chip capture: llama-tiny LoRA convergence run" "$RUNS"
+
+echo "[capture] done. artifacts:"
+find "$OUT" "$RUNS" -type f | sort

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Opportunistic capture loop (VERDICT r3 item 1): the axon tunnel is
+# intermittent, so probe jax.devices() with a hard timeout every
+# PROBE_SLEEP seconds all round and fire scripts/capture_round4.sh on the
+# first success. A plain jax.devices() call blocks FOREVER when the
+# tunnel is down (memory: axon-tunnel-flaky), hence the timeout wrapper
+# and the platform assert (a downed tunnel can also fall back to the CPU
+# backend, which must not masquerade as a chip capture).
+set -u
+cd "$(dirname "$0")/.."
+PROBE_SLEEP="${PROBE_SLEEP:-540}"
+DEADLINE="${DEADLINE:-$(($(date +%s) + ${WATCH_HOURS:-11} * 3600))}"
+export JAX_PLATFORMS=""
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if timeout 90 python -c "
+import jax
+d = jax.devices()[0]
+assert d.platform == 'tpu', f'backend is {d.platform}, not tpu'
+print('tpu up:', getattr(d, 'device_kind', '?'))
+" 2>/dev/null; then
+    echo "[watch] tunnel up at $(date -u +%FT%TZ) — starting capture"
+    bash scripts/capture_round4.sh
+    rc=$?
+    echo "[watch] capture finished rc=$rc"
+    exit $rc
+  fi
+  echo "[watch] tunnel down at $(date -u +%FT%TZ); retrying in ${PROBE_SLEEP}s"
+  sleep "$PROBE_SLEEP"
+done
+echo "[watch] deadline reached without a live tunnel"
+exit 1

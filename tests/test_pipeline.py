@@ -165,6 +165,62 @@ class TestPipelineTrainStep:
             assert np.isfinite(float(metrics["loss"]))
 
 
+class TestGPipeLayersFsdp:
+    """FSDP-within-stage (gpipe_apply_layers): stage params stay sharded
+    through the shard_map boundary and each layer is gathered on use."""
+
+    def _fsdp_setup(self):
+        from hyperion_tpu.parallel.partition import partition_specs
+
+        mesh = make_mesh(MeshSpec(data=1, fsdp=2, pipe=4))
+        model = PipelinedLM(tiny_cfg())
+        params = model.init_params(jax.random.key(0))
+        specs = partition_specs(params, mesh, fsdp=True, fsdp_min_size=2**8)
+        model.stage_specs = specs["stages"]
+        ids = np.random.default_rng(7).integers(0, VOCAB, (B, T)).astype(np.int32)
+        return mesh, model, {"params": params}, jnp.asarray(ids), specs
+
+    def test_stage_specs_keep_fsdp_sharding(self):
+        _, _, _, _, specs = self._fsdp_setup()
+        flat = jax.tree.leaves(
+            specs["stages"], is_leaf=lambda x: hasattr(x, "index")
+        )
+        assert any(AxisName.FSDP in sp for sp in flat), (
+            "no stages leaf claimed the fsdp axis — per-layer gather has "
+            "nothing to gather"
+        )
+        # the layer axis (dim 1) must stay whole for the per-layer scan
+        assert all(len(sp) < 2 or sp[1] is None for sp in flat)
+
+    def test_matches_sequential(self):
+        mesh, model, variables, ids, _ = self._fsdp_setup()
+        seq_model = PipelinedLM(tiny_cfg())  # stage_specs=None → sequential
+        ref = seq_model.apply(variables, ids)
+        with activate_mesh(mesh):
+            out = model.apply(variables, ids)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    @pytest.mark.slow
+    def test_grads_match_sequential(self):
+        mesh, model, variables, ids, _ = self._fsdp_setup()
+        seq_model = PipelinedLM(tiny_cfg())
+
+        def loss(params, m, pipelined):
+            ctx = activate_mesh(mesh) if pipelined else _null()
+            with ctx:
+                logits = m.apply({"params": params}, ids)
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+        g_ref = jax.grad(lambda p: loss(p, seq_model, False))(variables["params"])
+        g_pipe = jax.grad(lambda p: loss(p, model, True))(variables["params"])
+        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_pipe)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-5, rtol=3e-4
+            )
+
+
 class TestPartitionSpecs:
     def test_stages_claim_pipe_axis(self, mesh_pipe):
         from hyperion_tpu.parallel.partition import partition_specs

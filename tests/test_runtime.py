@@ -175,6 +175,62 @@ except CoordError as e:
         out0, _ = p0.communicate(timeout=120)
         assert "FAST_FAIL" in out0, out0
 
+    @pytest.mark.slow
+    def test_two_process_real_jax_distributed(self):
+        """The one branch the skip-jax tests never reach (dist.py:
+        jax.distributed.initialize): two real OS processes rendezvous
+        through the JAX coordination service on CPU, agree on
+        process_index/count and the global device view, and pass a real
+        `barrier()` (sync_global_devices), then cleanup()."""
+        import subprocess, sys, os, pathlib
+
+        worker = r"""
+import os, sys
+sys.path.insert(0, os.environ["HYP_REPO"])
+import jax
+from hyperion_tpu.runtime import dist
+
+dist.setup()
+rank = int(os.environ["RANK"])
+assert jax.process_count() == 2, jax.process_count()
+assert dist.process_count() == 2
+assert dist.process_index() == rank == jax.process_index()
+assert dist.is_primary() == (rank == 0)
+n_global = jax.device_count()
+n_local = len(jax.local_devices())
+assert n_global == 2 * n_local, (n_global, n_local)
+dist.barrier("real_jax_barrier")
+dist.cleanup()
+print(f"JAX_DIST_OK rank={rank} global_devices={n_global}")
+"""
+        from tests.test_native import free_port
+
+        jax_port, coord_port = free_port(), free_port()
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ)
+            env.update({
+                "RANK": str(rank), "WORLD_SIZE": "2",
+                # fresh ports per run: jax's coordinator AND the C++ host
+                # layer must not collide with parallel test invocations
+                "MASTER_ADDR": f"127.0.0.1:{jax_port}",
+                "HYPERION_COORD_PORT": str(coord_port),
+                "HYP_REPO": str(pathlib.Path(__file__).resolve().parents[1]),
+                "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": "",
+                # one CPU device per process keeps the global view simple
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            })
+            env.pop("HYPERION_SKIP_JAX_INIT", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", worker], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            ))
+        outs = [p.communicate(timeout=180)[0] for p in procs]
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank}:\n{out}"
+            assert f"JAX_DIST_OK rank={rank} global_devices=2" in out, out
+
     def test_comm_check_host_only_cli(self):
         import subprocess, sys, os, pathlib
 
