@@ -19,9 +19,14 @@ contract the ring/ulysses attention impls use; without an active mesh
 (or with pipe=1) the stages run sequentially, which is also the
 correctness reference the pipeline is tested against.
 
-Dropout must be 0: per-tick RNG threading through the rotating schedule
-is not implemented (the toy/GPT-2 configs train fine without it; the
-reference's compile benchmark also ran dropout-free).
+Dropout works under the pipeline: the dropout key is split per
+microbatch and rides the (replicated) extras indexing through the
+rotating schedule, so at tick t stage s derives its noise from
+fold_in(key_microbatch, stage, layer) — deterministic per (key,
+microbatch, stage, layer) regardless of schedule interleaving. The
+realized masks differ from the sequential fallback's (which folds the
+same indices over the whole batch at once) the way any layout change
+reseeds dropout; loss statistics are equivalent.
 """
 
 from __future__ import annotations
@@ -50,8 +55,6 @@ class PipelineLMConfig:
                 f"n_layers {self.base.n_layers} not divisible by "
                 f"n_stages {self.n_stages}"
             )
-        if self.base.dropout:
-            raise ValueError("pipeline LM requires dropout=0 (see module doc)")
 
     @property
     def layers_per_stage(self) -> int:
@@ -117,37 +120,80 @@ class PipelinedLM:
 
     # -- forward ------------------------------------------------------
 
-    def _stage_fn(self, stage_params, x, pad):
+    @staticmethod
+    def _block_rngs(rng):
+        return None if rng is None else {"dropout": rng}
+
+    def _stage_fn(self, stage_params, x, pad, rng_s=None):
         """Apply this stage's layers_per_stage blocks sequentially,
-        honouring cfg.remat_policy (same wrapper as TransformerLM)."""
+        honouring cfg.remat_policy (same wrapper as TransformerLM).
+        `rng_s` is this (microbatch, stage)'s dropout key — already
+        stage-folded by the caller; each layer folds in its index."""
         c = self.cfg.base
         block = remat_block_cls(c)
 
-        def body(h, blk):
-            h = block(c).apply({"params": blk}, h, pad, True)
+        def body(h, blk_i):
+            blk, i = blk_i
+            rng_l = None if rng_s is None else jax.random.fold_in(rng_s, i)
+            h = block(c).apply(
+                {"params": blk}, h, pad, rng_l is None,
+                rngs=self._block_rngs(rng_l),
+            )
             return h, None
 
-        x, _ = jax.lax.scan(body, x, stage_params)
+        lps = self.cfg.layers_per_stage
+        x, _ = jax.lax.scan(body, x, (stage_params, jnp.arange(lps)))
         return x
 
-    def _layer_fn(self, blk, x, pad):
+    def _pipe_stage_fn(self, stage_params, x, pad, rng_mb=None):
+        """gpipe_apply's stage callback: fold the (shard_map-local)
+        stage index into the microbatch key, then run the stage."""
+        from jax import lax
+
+        rng_s = (
+            None if rng_mb is None
+            else jax.random.fold_in(rng_mb, lax.axis_index(AxisName.PIPE))
+        )
+        return self._stage_fn(stage_params, x, pad, rng_s)
+
+    def _layer_fn(self, blk, x, pad, rng_l=None):
         """One block on fully-gathered layer params — the per-layer unit
         `gpipe_apply_layers` gathers+checkpoints (plain Block, not the
         remat wrapper: the pipeline's own checkpoint covers it AND the
-        gather, which a block-level wrapper could not)."""
-        return Block(self.cfg.base).apply({"params": blk}, x, pad, True)
+        gather, which a block-level wrapper could not). `rng_l` arrives
+        already folded with (microbatch, stage, layer)."""
+        return Block(self.cfg.base).apply(
+            {"params": blk}, x, pad, rng_l is None,
+            rngs=self._block_rngs(rng_l),
+        )
 
     def apply(self, variables, input_ids, padding_mask=None,
               deterministic: bool = True, rngs=None):
-        del deterministic, rngs  # dropout-free by construction
         p = variables["params"]
         c = self.cfg.base
         B, T = input_ids.shape
         if T > c.max_len:
             raise ValueError(f"seq len {T} > max_len {c.max_len}")
 
+        drop_rng = None
+        if not deterministic and c.dropout > 0.0:
+            if rngs is None:
+                raise ValueError(
+                    "dropout > 0 with deterministic=False needs "
+                    "rngs={'dropout': key}"
+                )
+            drop_rng = rngs["dropout"] if isinstance(rngs, dict) else rngs
+        emb_rng = pipe_rng = None
+        if drop_rng is not None:
+            emb_rng, pipe_rng = jax.random.split(drop_rng)
+
         x = p["tok_emb"]["embedding"][input_ids].astype(c.compute_dtype)
         x = x + p["pos_emb"]["embedding"][:T].astype(c.compute_dtype)[None]
+        if emb_rng is not None:
+            # embedding dropout, matching TransformerLM's post-embedding
+            # nn.Dropout — functional here (outside any flax module)
+            keep = jax.random.bernoulli(emb_rng, 1.0 - c.dropout, x.shape)
+            x = jnp.where(keep, x / (1.0 - c.dropout), 0.0).astype(x.dtype)
 
         mesh = active_mesh()
         if mesh is not None and mesh.shape[AxisName.PIPE] > 1:
@@ -165,19 +211,31 @@ class PipelinedLM:
                     # remat in gpipe's per-layer checkpoint, which also
                     # covers the gather; cfg.remat would double-wrap
                     remat_layers=True,
+                    rng=pipe_rng,
                 )
             else:
                 x = gpipe_apply(
-                    self._stage_fn, p["stages"], x, mesh,
+                    self._pipe_stage_fn, p["stages"], x, mesh,
                     n_microbatches=self.cfg.n_microbatches,
                     extras=padding_mask,  # None passes through as empty pytree
+                    rng=pipe_rng,
                 )
         else:
-            # sequential reference path: scan stages in order
-            def run_stage(h, stage_p):
-                return self._stage_fn(stage_p, h, padding_mask), None
+            # sequential reference path: scan stages in order; dropout
+            # folds (stage, layer) from the base key — same recipe as
+            # the pipeline, minus the microbatch split (whole batch is
+            # one microbatch here)
+            def run_stage(h, stage_i):
+                stage_p, s = stage_i
+                rng_s = (
+                    None if pipe_rng is None
+                    else jax.random.fold_in(pipe_rng, s)
+                )
+                return self._stage_fn(stage_p, h, padding_mask, rng_s), None
 
-            x, _ = jax.lax.scan(run_stage, x, p["stages"])
+            x, _ = jax.lax.scan(
+                run_stage, x, (p["stages"], jnp.arange(self.cfg.n_stages))
+            )
 
         # final norm + head in fp32 logits, matching TransformerLM —
         # including the tier's norm kernel choice

@@ -121,6 +121,7 @@ def gpipe_apply(
     axis_name: str = AxisName.PIPE,
     batch_axes: tuple[str, ...] | None = None,
     param_in_specs: Any = None,
+    rng: jax.Array | None = None,
 ) -> jax.Array:
     """Run `x` through the S-stage pipeline; returns same-shape output.
 
@@ -129,6 +130,13 @@ def gpipe_apply(
     leaves are [S, layers_per_stage, ...] sharded over `axis_name`;
     `x` is [B, ...] with B divisible by n_microbatches; leaves of
     `extras` are [B, ...] side inputs that follow their microbatch.
+
+    `rng` threads dropout noise through the rotating schedule: the key
+    is split per microbatch and the split keys ride the (replicated)
+    extras indexing, so at tick t stage s receives the key of the
+    microbatch it is processing. stage_fn is then called as
+    stage_fn(params, x_mb, extra_mb, rng_mb) and should fold in its own
+    stage/layer indices (`lax.axis_index(axis_name)` is live inside).
 
     Memory note: the default in_spec `P(axis_name)` gathers each stage's
     FULL parameter slice (all its layers, all dims) onto its devices for
@@ -163,6 +171,18 @@ def gpipe_apply(
     extras = jax.tree.map(to_micro, extras)
 
     mb_spec = P(None, batch_axes)  # [M, mb@batch, ...]
+    extras_specs = jax.tree.map(lambda _: mb_spec, extras)
+    if rng is not None:
+        # per-microbatch keys ride the same [M]-leading index as extras,
+        # but replicated (every stage sees every microbatch's key and
+        # picks the one for the microbatch it is on)
+        extras = (extras, jax.random.split(rng, M))
+        extras_specs = (extras_specs, P())
+        user_fn = stage_fn
+
+        def stage_fn(params, x_mb, extra):  # noqa: F811 — deliberate wrap
+            return user_fn(params, x_mb, extra[0], extra[1])
+
     param_specs = (
         P(axis_name) if param_in_specs is None else param_in_specs
     )
@@ -171,7 +191,7 @@ def gpipe_apply(
             _local_gpipe, stage_fn=stage_fn, axis_name=axis_name, n_micro=M
         ),
         mesh=mesh,
-        in_specs=(param_specs, mb_spec, jax.tree.map(lambda _: mb_spec, extras)),
+        in_specs=(param_specs, mb_spec, extras_specs),
         out_specs=P(axis_name, None, batch_axes),  # [S@pipe, M, mb@batch, ...]
     )
     out = fn(stage_params, xs, extras)  # [S, M, mb, ...]
@@ -183,7 +203,8 @@ def _flatten_specs(specs: Any) -> list[P]:
 
 
 def _gather_plans(
-    flat_params: list, flat_specs: list[P], axis_name: str
+    flat_params: list, flat_specs: list[P], axis_name: str,
+    batch_axes: tuple[str, ...],
 ) -> list[tuple[tuple[int, tuple[str, ...]], ...]]:
     """Per leaf: ((layer-local dim, mesh axes to all_gather), ...).
 
@@ -191,7 +212,14 @@ def _gather_plans(
     and dim 1 (the layer axis the tick scans) must be unsharded —
     `partition_specs` guarantees both for stages/ leaves. Body dims
     shift by 2 once the pipe shard is peeled and the layer scan indexes
-    the lps axis."""
+    the lps axis.
+
+    Only axes the pipeline OUTPUT already varies over (the batch axes —
+    fsdp rides there) may be gathered: an all_gather keeps its axis
+    varying in shard_map's type system, and out_specs mentions only
+    pipe + batch axes, so gathering e.g. the 'model' (TP) axis inside
+    the tick cannot type-check. TP stage leaves belong on the classic
+    whole-stage `gpipe_apply` path instead."""
     plans = []
     for leaf, spec in zip(flat_params, flat_specs):
         entries = tuple(spec) + (None,) * (np.ndim(leaf) - len(spec))
@@ -210,6 +238,15 @@ def _gather_plans(
             if e is None:
                 continue
             names = e if isinstance(e, tuple) else (e,)
+            bad = [n for n in names if n not in batch_axes]
+            if bad:
+                raise ValueError(
+                    f"stage leaf spec {spec} shards dim {d + 2} over "
+                    f"{bad}, which the pipeline output does not vary "
+                    f"over (batch axes: {batch_axes}) — per-layer gather "
+                    "supports fsdp-style sharding only; use gpipe_apply "
+                    "(whole-stage gather) for TP-sharded stages"
+                )
             plan.append((d, tuple(names)))
         plans.append(tuple(plan))
     return plans
@@ -227,6 +264,7 @@ def gpipe_apply_layers(
     axis_name: str = AxisName.PIPE,
     batch_axes: tuple[str, ...] | None = None,
     remat_layers: bool = True,
+    rng: jax.Array | None = None,
 ) -> jax.Array:
     """GPipe with FSDP-within-stage: ZeRO-3 semantics inside the tick.
 
@@ -242,6 +280,10 @@ def gpipe_apply_layers(
     buffers alive across the schedule — exactly FSDP's
     gather-on-use/free-after-use, expressed as layout + rematerialization
     (the gather's transpose is the grads' reduce-scatter, inserted by AD).
+
+    With `rng`, layer_fn is called as layer_fn(layer, x, extra, rng_l)
+    where rng_l is already folded with the microbatch, stage, and layer
+    indices (dropout-ready).
     """
     flat, treedef = jax.tree.flatten(stage_params)
     flat_specs = _flatten_specs(param_specs)
@@ -250,30 +292,43 @@ def gpipe_apply_layers(
             f"param_specs has {len(flat_specs)} leaves, stage_params "
             f"{len(flat)}"
         )
-    plans = _gather_plans(flat, flat_specs, axis_name)
+    plans = _gather_plans(
+        flat, flat_specs, axis_name,
+        AxisName.BATCH if batch_axes is None else batch_axes,
+    )
+    n_layers = jax.tree.leaves(stage_params)[0].shape[1]
 
-    def apply_layer(h, layer, extra):
+    def apply_layer(h, layer, extra, rng_l):
         flat_layer = jax.tree.leaves(layer)
         full = jax.tree.unflatten(treedef, [
             _all_gather_dims(a, plan) for a, plan in zip(flat_layer, plans)
         ])
-        return layer_fn(full, h, extra)
+        if rng_l is None:
+            return layer_fn(full, h, extra)
+        return layer_fn(full, h, extra, rng_l)
 
     if remat_layers:
         apply_layer = jax.checkpoint(apply_layer)
 
-    def stage_fn(params, x, extra):
+    def stage_fn(params, x, extra, rng_mb=None):
         # params leaves [lps, ...] (pipe dim already peeled): scan layers
-        def body(h, layer):
-            return apply_layer(h, layer, extra), None
+        rng_s = (
+            None if rng_mb is None
+            else jax.random.fold_in(rng_mb, lax.axis_index(axis_name))
+        )
 
-        x, _ = lax.scan(body, x, params)
+        def body(h, layer_i):
+            layer, i = layer_i
+            rng_l = None if rng_s is None else jax.random.fold_in(rng_s, i)
+            return apply_layer(h, layer, extra, rng_l), None
+
+        x, _ = lax.scan(body, x, (params, jnp.arange(n_layers)))
         return x
 
     return gpipe_apply(
         stage_fn, stage_params, x, mesh,
         n_microbatches=n_microbatches, extras=extras, axis_name=axis_name,
-        batch_axes=batch_axes, param_in_specs=param_specs,
+        batch_axes=batch_axes, param_in_specs=param_specs, rng=rng,
     )
 
 

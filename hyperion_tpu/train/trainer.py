@@ -308,6 +308,23 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
     policy = get_policy(cfg.optimization.precision)
     tier_impl = _tier_impls(cfg)
     pipe = mesh.shape["pipe"]
+    # TP shards lm_head/tok_emb on the vocab dim, and GPT-2's 50257 is
+    # prime-ish — pad to the next multiple of the model axis (the
+    # standard megatron/neox 50304-style trick: padded ids never occur
+    # in data, their logits just learn to be suppressed)
+    model_ax = mesh.shape["model"]
+    vocab_kw = {}
+    if model_ax > 1:
+        from hyperion_tpu.models.transformer_lm import GPT2_VOCAB_SIZE
+
+        padded = -(-GPT2_VOCAB_SIZE // model_ax) * model_ax
+        if padded != GPT2_VOCAB_SIZE:
+            vocab_kw = {"vocab_size": padded}
+            if dist.is_primary():
+                print(
+                    f"[{job}] tp: vocab padded {GPT2_VOCAB_SIZE} -> "
+                    f"{padded} (divisible by model={model_ax})"
+                )
     if pipe > 1 and cfg.train.moe_experts > 0:
         # Deliberate exclusion, not a TODO: the pipeline stacks stage
         # leaves as [S, lps, ...] on the pipe axis while MoE stacks
@@ -331,10 +348,11 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
 
         base = simple_lm_config(
             max_len=cfg.train.seq_len,
-            dropout=0.0,
+            dropout=0.1,  # per-tick RNG threading makes this like-for-like
             remat=cfg.optimization.remat,
             dtype=jnp.dtype(policy.compute_dtype).name,
             **_model_impls(tier_impl),
+            **vocab_kw,
         )
         if base.n_layers % pipe:
             # smallest layer count that fills every stage (the toy LM's 2
@@ -342,18 +360,26 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
             n_layers = -(-base.n_layers // pipe) * pipe
             base = dataclasses.replace(base, n_layers=n_layers)
         if dist.is_primary():
-            # the pipe run is a different architecture than the plain
-            # job (layer rounding, dropout off) — say so next to the
-            # CSVs it writes rather than only in a code comment
+            # layer rounding can still change the architecture vs the
+            # plain job — say so next to the CSVs it writes rather than
+            # only in a code comment (dropout now matches: per-tick RNG
+            # threading keeps 0.1 live under the pipeline)
             print(
                 f"[{job}] pipeline mesh (pipe={pipe}): n_layers="
-                f"{base.n_layers}, dropout=0.0 (plain job: 2 layers, 0.1)"
+                f"{base.n_layers}, dropout=0.1"
             )
-            if is_fsdp:
+            if is_fsdp and mesh.shape["model"] == 1:
                 print(
                     f"[{job}] pipe+fsdp: per-layer gather inside the "
                     "pipeline tick (gpipe_apply_layers) — stage params "
                     "stay fsdp-sharded; peak gathered memory is one layer"
+                )
+            elif is_fsdp:
+                print(
+                    f"[{job}] pipe+fsdp+tp: whole-stage gather (TP-"
+                    "sharded stages cannot ride the per-layer path) — "
+                    "each stage's full parameter slice is materialized "
+                    "per step"
                 )
         model = PipelinedLM(PipelineLMConfig(
             base=base,
@@ -372,6 +398,7 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
             remat=cfg.optimization.remat,
             dtype=jnp.dtype(policy.compute_dtype).name,
             **_model_impls(tier_impl),
+            **vocab_kw,
         )
         model = MoELM(MoELMConfig(
             base=base,
@@ -391,6 +418,7 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
             remat=cfg.optimization.remat,
             dtype=jnp.dtype(policy.compute_dtype).name,
             **_model_impls(tier_impl),
+            **vocab_kw,
         ))
     optimizer = make_optimizer(
         cfg.train.learning_rate, cfg.train.weight_decay,
@@ -404,8 +432,13 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
         tp_rules=TRANSFORMER_TP_RULES,
         fsdp=is_fsdp,
     )
-    if pipe > 1 and (is_fsdp or mesh.shape["model"] > 1):
-        # per-layer gather inside the tick: params stay fsdp/tp-sharded
+    if pipe > 1 and is_fsdp and mesh.shape["model"] == 1:
+        # per-layer gather inside the tick: params stay fsdp-sharded.
+        # TP (model>1) stays on the classic whole-stage gather: the
+        # shard_map output can only vary over pipe + the batch axes, so
+        # a 'model'-axis gather inside the tick cannot type-check
+        # (gpipe_apply_layers enforces this; fsdp rides along as a
+        # batch axis, which is what makes the ZeRO-3 path legal).
         model.attach_stage_specs(sharding)
 
     has_aux = hasattr(model, "apply_with_aux")  # MoE router balance loss
@@ -726,6 +759,43 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
         ckpt_dir=ckpt_dir, resume_epoch=resume_epoch,
         eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
     )
+    if dist.is_primary() and history:
+        # committed evidence row for "the 7B path at size": step time,
+        # tokens/s, peak HBM — the numbers BASELINE.md's Llama row is
+        # judged against (reference: 4123 s/epoch bs1 on one MI250X).
+        # Best epoch = compile excluded whenever epochs >= 2.
+        import json as _json
+        from pathlib import Path as _Path
+
+        from hyperion_tpu.utils.memory import peak_bytes_in_use
+
+        steps = min(len(batches), cfg.train.steps_per_epoch or len(batches))
+        toks_per_epoch = cfg.train.batch_size * cfg.train.seq_len * steps
+        best_s = min(h.duration_s for h in history)
+        summary = {
+            "job": job, "mode": mode, "model": cfg.train.model,
+            "batch_size": cfg.train.batch_size,
+            "seq_len": cfg.train.seq_len,
+            "steps_per_epoch": steps, "epochs_run": len(history),
+            "best_epoch_s": round(best_s, 2),
+            "step_ms": round(best_s / steps * 1e3, 1),
+            "tokens_per_s": round(toks_per_epoch / best_s, 1),
+            "final_loss": round(history[-1].loss, 4),
+            "params_m": round(sum(
+                x.size for x in jax.tree.leaves(state.params)) / 1e6, 1),
+            "peak_hbm_mb": round(peak_bytes_in_use() / 1e6, 1),
+            "remat": cfg.optimization.remat,
+            "grad_accum": cfg.optimization.grad_accum_steps,
+            "devices": n_dev,
+            "backend": jax.default_backend(),
+        }
+        if cfg.train.lora:
+            summary["lora_rank"] = cfg.train.lora_rank
+        path = _Path(f"{cfg.train.base_dir}/distributed/{logger.run}_summary.json")
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(_json.dumps(summary, indent=2))
+        print(f"[{job}] summary: {_json.dumps(summary)}")
+
     # save_pretrained analogue: adapters alone for LoRA, else full params
     export = state.params["lora"] if cfg.train.lora else state.params
     ckpt.export_gathered(

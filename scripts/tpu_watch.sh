@@ -22,8 +22,14 @@ print('tpu up:', getattr(d, 'device_kind', '?'))
     echo "[watch] tunnel up at $(date -u +%FT%TZ) — starting capture"
     bash scripts/capture_round4.sh
     rc=$?
-    echo "[watch] capture finished rc=$rc"
-    exit $rc
+    if [ "$rc" -eq 0 ]; then
+      echo "[watch] capture complete"
+      exit 0
+    fi
+    # a flapping tunnel can kill the capture seconds after a good probe;
+    # each stage commits incrementally, so retrying on the next probe is
+    # safe and preserves the rest of the watch window
+    echo "[watch] capture rc=$rc (tunnel flapped?); continuing to watch"
   fi
   echo "[watch] tunnel down at $(date -u +%FT%TZ); retrying in ${PROBE_SLEEP}s"
   sleep "$PROBE_SLEEP"

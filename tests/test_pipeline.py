@@ -17,11 +17,11 @@ from hyperion_tpu.runtime.mesh import (
 VOCAB, T, B = 64, 16, 8
 
 
-def tiny_cfg(n_stages=4, n_micro=4, n_layers=4):
+def tiny_cfg(n_stages=4, n_micro=4, n_layers=4, dropout=0.0):
     return PipelineLMConfig(
         base=simple_lm_config(
             vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=n_layers,
-            ff_dim=64, max_len=T, dropout=0.0,
+            ff_dim=64, max_len=T, dropout=dropout,
         ),
         n_stages=n_stages,
         n_microbatches=n_micro,
@@ -165,6 +165,58 @@ class TestPipelineTrainStep:
             assert np.isfinite(float(metrics["loss"]))
 
 
+class TestPipelineDropout:
+    """Per-tick RNG threading: dropout is live, deterministic per key,
+    and key-sensitive under the rotating schedule."""
+
+    def _setup(self):
+        model = PipelinedLM(tiny_cfg(dropout=0.5))
+        params = model.init_params(jax.random.key(0))
+        ids = np.random.default_rng(9).integers(0, VOCAB, (B, T)).astype(np.int32)
+        return model, {"params": params}, jnp.asarray(ids)
+
+    def test_dropout_applied_and_deterministic(self, mesh_pipe):
+        model, variables, ids = self._setup()
+        rngs = {"dropout": jax.random.key(42)}
+        with activate_mesh(mesh_pipe):
+            det = model.apply(variables, ids)
+            d1 = model.apply(variables, ids, deterministic=False, rngs=rngs)
+            d2 = model.apply(variables, ids, deterministic=False, rngs=rngs)
+            d3 = model.apply(
+                variables, ids, deterministic=False,
+                rngs={"dropout": jax.random.key(43)},
+            )
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+        assert not np.allclose(np.asarray(d1), np.asarray(det)), (
+            "dropout had no effect under the pipeline"
+        )
+        assert not np.allclose(np.asarray(d1), np.asarray(d3)), (
+            "different dropout keys produced identical outputs"
+        )
+
+    def test_dropout_in_fsdp_layers_path(self):
+        from hyperion_tpu.parallel.partition import partition_specs
+
+        mesh = make_mesh(MeshSpec(data=1, fsdp=2, pipe=4))
+        model, variables, ids = self._setup()
+        specs = partition_specs(
+            variables["params"], mesh, fsdp=True, fsdp_min_size=2**8
+        )
+        model.stage_specs = specs["stages"]
+        rngs = {"dropout": jax.random.key(7)}
+        with activate_mesh(mesh):
+            det = model.apply(variables, ids)
+            d1 = model.apply(variables, ids, deterministic=False, rngs=rngs)
+            d2 = model.apply(variables, ids, deterministic=False, rngs=rngs)
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+        assert not np.allclose(np.asarray(d1), np.asarray(det))
+
+    def test_missing_rng_raises(self, mesh_pipe):
+        model, variables, ids = self._setup()
+        with activate_mesh(mesh_pipe), pytest.raises(ValueError, match="rngs"):
+            model.apply(variables, ids, deterministic=False)
+
+
 class TestGPipeLayersFsdp:
     """FSDP-within-stage (gpipe_apply_layers): stage params stay sharded
     through the shard_map boundary and each layer is gathered on use."""
@@ -219,6 +271,44 @@ class TestGPipeLayersFsdp:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=3e-5, rtol=3e-4
             )
+
+
+class TestGPipeTP:
+    """PP+TP stays on the classic whole-stage-gather path: TP-sharded
+    stage leaves cannot ride the per-layer gather (the shard_map output
+    does not vary over 'model'), so gpipe_apply_layers must refuse them
+    with a clear error while plain gpipe_apply executes correctly."""
+
+    def _tp_setup(self):
+        from hyperion_tpu.parallel.partition import (
+            TRANSFORMER_TP_RULES, partition_specs,
+        )
+
+        mesh = make_mesh(MeshSpec(data=2, model=2, pipe=2))
+        model = PipelinedLM(tiny_cfg(n_stages=2, n_micro=2))
+        params = model.init_params(jax.random.key(0))
+        specs = partition_specs(
+            params, mesh, tp_rules=TRANSFORMER_TP_RULES, fsdp=False
+        )
+        ids = np.random.default_rng(11).integers(0, VOCAB, (B, T)).astype(np.int32)
+        return mesh, model, {"params": params}, jnp.asarray(ids), specs
+
+    def test_pp_tp_executes_via_whole_stage_path(self):
+        mesh, model, variables, ids, _ = self._tp_setup()
+        assert model.stage_specs is None  # trainer keeps TP off this path
+        seq_model = PipelinedLM(tiny_cfg(n_stages=2, n_micro=2))
+        ref = seq_model.apply(variables, ids)
+        with activate_mesh(mesh):
+            out = model.apply(variables, ids)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_layers_path_rejects_tp_specs(self):
+        mesh, model, variables, ids, specs = self._tp_setup()
+        model.stage_specs = specs["stages"]
+        with activate_mesh(mesh), pytest.raises(ValueError, match="whole-stage"):
+            model.apply(variables, ids)
 
 
 class TestPartitionSpecs:
