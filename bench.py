@@ -238,7 +238,22 @@ def _run_child(mode: str, timeout_s: int) -> tuple[dict | None, str]:
 
 
 def main() -> None:
+    import time
+
+    t0 = time.monotonic()
     primary, err = _run_child("--child-matmul", PRIMARY_TIMEOUT_S)
+    # Bounded retry for FAST failures only (crash/rc!=0): a flap at the
+    # wrong moment should not turn the round's record into a failure
+    # line when the next attempt would succeed. A first attempt that
+    # burned its full timeout means the backend is down — retrying
+    # would push past the capture script's outer time limit and kill
+    # the process before the parseable failure line prints.
+    for _ in range(int(os.environ.get("HYPERION_BENCH_RETRIES", "1"))):
+        if primary is not None:
+            break
+        if time.monotonic() - t0 > PRIMARY_TIMEOUT_S / 2:
+            break
+        primary, err = _run_child("--child-matmul", PRIMARY_TIMEOUT_S)
     metric = f"matmul_bf16_{N}_tflops"  # baseline only comparable at N=8192
     if primary is None:
         out = {

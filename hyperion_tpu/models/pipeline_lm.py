@@ -177,7 +177,9 @@ class PipelinedLM:
 
         drop_rng = None
         if not deterministic and c.dropout > 0.0:
-            if rngs is None:
+            if rngs is None or (
+                isinstance(rngs, dict) and "dropout" not in rngs
+            ):
                 raise ValueError(
                     "dropout > 0 with deterministic=False needs "
                     "rngs={'dropout': key}"

@@ -179,9 +179,17 @@ def gpipe_apply(
         extras = (extras, jax.random.split(rng, M))
         extras_specs = (extras_specs, P())
         user_fn = stage_fn
+        rng_axes = batch_axes
 
         def stage_fn(params, x_mb, extra):  # noqa: F811 — deliberate wrap
-            return user_fn(params, x_mb, extra[0], extra[1])
+            # each batch shard holds DIFFERENT samples, so its dropout
+            # noise must differ too: fold the shard coordinates in
+            # before the microbatch key reaches the stage (axis_index
+            # of a size-1 axis is 0 — harmless)
+            rng_mb = extra[1]
+            for ax in rng_axes:
+                rng_mb = jax.random.fold_in(rng_mb, lax.axis_index(ax))
+            return user_fn(params, x_mb, extra[0], rng_mb)
 
     param_specs = (
         P(axis_name) if param_in_specs is None else param_in_specs

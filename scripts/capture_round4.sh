@@ -31,12 +31,16 @@ commit() {  # commit <msg> <paths...> — retries around concurrent commits
   echo "[capture] COMMIT FAILED: $msg" >&2
 }
 
+FAILED=0
 run() {  # run <timeout_s> <label> <cmd...>
   local t="$1" label="$2"; shift 2
   echo "[capture] === $label ($(date -u +%FT%TZ), limit ${t}s) ==="
   timeout "$t" "$@"
   local rc=$?
-  [ $rc -ne 0 ] && echo "[capture] $label rc=$rc — continuing" >&2
+  if [ $rc -ne 0 ]; then
+    echo "[capture] $label rc=$rc — continuing" >&2
+    FAILED=$((FAILED + 1))
+  fi
   return $rc
 }
 
@@ -59,20 +63,24 @@ fi
 #    tunnel dies again. bench_live.json only ever holds a GOOD headline
 #    (bench.py's last_committed fallback reads it from HEAD): a failure
 #    line lands in bench_live_latest.json but never overwrites it.
-run 1500 bench.py bash -c "python bench.py | tee $OUT/bench_live_latest.json"
-python - <<'EOF'
-import json, shutil
+run 1800 bench.py bash -c "python bench.py | tee $OUT/bench_live_latest.json"
+python - <<'EOF' || FAILED=$((FAILED + 1))
+import json, sys, shutil
 try:
     doc = json.loads(open("results/benchmarks/bench_live_latest.json")
                      .read().strip().splitlines()[-1])
-    if doc.get("value"):
-        shutil.copy("results/benchmarks/bench_live_latest.json",
-                    "results/benchmarks/bench_live.json")
-        print("[capture] headline is good; bench_live.json updated")
-    else:
-        print("[capture] headline failed/zero; bench_live.json untouched")
 except Exception as e:
     print(f"[capture] bench_live.json not updated: {e}")
+    sys.exit(1)
+if doc.get("value"):
+    shutil.copy("results/benchmarks/bench_live_latest.json",
+                "results/benchmarks/bench_live.json")
+    print("[capture] headline is good; bench_live.json updated")
+else:
+    # a zero headline means the tunnel died under the bench: count the
+    # stage as failed so the watcher retries the capture later
+    print("[capture] headline failed/zero; bench_live.json untouched")
+    sys.exit(1)
 EOF
 commit "Real-chip capture: headline bench (bf16 matmul + LM step)" "$OUT"
 
@@ -118,5 +126,13 @@ run 2400 llama_tiny_lora python -m hyperion_tpu.cli.main \
   --model llama --llama_size tiny --lora --epochs 3 --base_dir "$RUNS"
 commit "Real-chip capture: llama-tiny LoRA convergence run" "$RUNS"
 
-echo "[capture] done. artifacts:"
+echo "[capture] artifacts:"
 find "$OUT" "$RUNS" -type f | sort
+if [ "$FAILED" -ne 0 ]; then
+  # a nonzero exit tells tpu_watch.sh the capture is INCOMPLETE (tunnel
+  # likely flapped mid-run) so it keeps watching and retries later;
+  # completed stages are already committed, so a retry is cheap
+  echo "[capture] $FAILED stage(s) failed — exiting 2 for the watcher" >&2
+  exit 2
+fi
+echo "[capture] all stages complete"

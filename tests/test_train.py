@@ -236,3 +236,25 @@ class TestSeqParallelTraining:
         cfg.optimization.attention_impl = "ring"
         res = train_language_model(cfg)
         assert np.isfinite(res.final_loss)
+
+
+class TestPipelineTraining:
+    @pytest.mark.slow
+    def test_language_trainer_with_fsdp_pipeline(self, tmp_path):
+        """End-to-end pipeline training with FSDP inside each stage:
+        mesh (data=1, fsdp=2, pipe=4), per-layer gather in the tick
+        (gpipe_apply_layers), dropout live via per-tick RNG threading."""
+        from hyperion_tpu.train.trainer import train_language_model
+
+        cfg = Config()
+        cfg.train.epochs = 1
+        cfg.train.batch_size = 8
+        cfg.train.seq_len = 16
+        cfg.train.steps_per_epoch = 2
+        cfg.train.base_dir = str(tmp_path)
+        cfg.train.validate = False
+        cfg.distributed.data = 1
+        cfg.distributed.fsdp = 2
+        cfg.distributed.pipe = 4
+        res = train_language_model(cfg, "language_fsdp")
+        assert np.isfinite(res.final_loss)
