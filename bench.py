@@ -33,6 +33,17 @@ import os
 import subprocess
 import sys
 
+# Persistent XLA compilation cache: over the axon tunnel a cold GPT-2
+# train-step compile alone can exceed the child timeout (420s observed),
+# so repeat runs (watcher retries, the round-end driver bench) must not
+# re-pay it. Set before any jax import; harmless if the backend declines
+# to serialize. Benchmarked quantities are run times, never compile wall
+# time, so a warm cache changes setup cost only.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+
 BASELINE_TFLOPS_BF16_8192 = 121.07  # MI250X bf16 8192^2 (BASELINE.md)
 N = int(os.environ.get("HYPERION_BENCH_N", "8192"))  # override for smoke tests
 PRIMARY_TIMEOUT_S = int(os.environ.get("HYPERION_BENCH_TIMEOUT", "600"))

@@ -15,6 +15,11 @@ OUT=results/benchmarks
 RUNS=results/tpu_runs
 mkdir -p "$OUT" "$RUNS"
 export JAX_PLATFORMS=""   # never inherit a test shell's cpu pin
+# Warm-compile persistence across stages and retries: a cold train-step
+# compile over the tunnel can exceed a child timeout; the cache makes the
+# second attempt (watcher retry / round-end driver bench) near-instant.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+export HYPERION_BENCH_EXTRA_TIMEOUT="${HYPERION_BENCH_EXTRA_TIMEOUT:-900}"
 
 commit() {  # commit <msg> <paths...> — retries around concurrent commits
   local msg="$1"; shift
