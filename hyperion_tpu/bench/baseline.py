@@ -159,7 +159,7 @@ def benchmark_model(
 
 def batch_size_scaling(
     name: str, batch_sizes=(1, 2, 4, 8, 16, 32, 64), dtype: str = "bfloat16",
-    iters: int = 10,
+    iters: int = 10, sink=None,
 ) -> list[dict]:
     """Reference `test_batch_size_scaling`: sweep until OOM, break
     gracefully (baseline_performance.ipynb cell 0:295-340)."""
@@ -171,6 +171,8 @@ def batch_size_scaling(
             msg = str(e).splitlines()[0][:120]
             print(f"[baseline] {name} bs={bs}: stopping sweep ({msg})")
             break
+        if sink is not None:
+            sink(rows)
     return rows
 
 
@@ -216,8 +218,11 @@ def main(argv=None) -> None:
     for name in args.models:
         r = benchmark_model(name, args.batch_size, args.dtype, iters=args.iters)
         rows.append(r)
+        # flush per model: a cold compile over the tunnel can blow the
+        # capture stage's time limit — measured rows must already be on
+        # disk when SIGTERM lands
+        _write_csv(out / "model_benchmarks.csv", rows)
         print(f"[baseline] {json.dumps(r)}")
-    _write_csv(out / "model_benchmarks.csv", rows)
     try_plot(plot_baseline_models, rows, out / "model_benchmarks.png")
 
     if args.precisions:
@@ -227,25 +232,32 @@ def main(argv=None) -> None:
             for dt in args.precisions:
                 if dt == args.dtype and name in by_model:
                     prec_rows.append(by_model[name])  # already measured
-                    continue
-                try:
-                    prec_rows.append(
-                        benchmark_model(name, args.batch_size, dt,
-                                        iters=args.iters)
-                    )
-                except Exception as e:  # noqa: BLE001 — one OOM must not
-                    # kill the rest of the capture (fp32 doubles memory)
-                    print(f"[baseline] precision {name}/{dt} failed: "
-                          f"{str(e).splitlines()[0][:120]}")
+                else:
+                    try:
+                        prec_rows.append(
+                            benchmark_model(name, args.batch_size, dt,
+                                            iters=args.iters)
+                        )
+                    except Exception as e:  # noqa: BLE001 — one OOM must
+                        # not kill the rest of the capture (fp32 doubles
+                        # memory)
+                        print(f"[baseline] precision {name}/{dt} failed: "
+                              f"{str(e).splitlines()[0][:120]}")
+                        continue
+                # flush after EVERY append (reuse rows included): the
+                # next measurement may be the one SIGTERM lands on
+                _write_csv(out / "precision_comparison.csv", prec_rows)
         for r in prec_rows:
             print(f"[baseline] precision {json.dumps(r)}")
-        _write_csv(out / "precision_comparison.csv", prec_rows)
 
     if args.scaling:
         sweeps = {}
         for name in args.models:
-            sweep = batch_size_scaling(name, args.batch_sizes, args.dtype)
-            _write_csv(out / f"{name}_batch_scaling.csv", sweep)
+            sweep = batch_size_scaling(
+                name, args.batch_sizes, args.dtype,
+                sink=lambda rows, p=out / f"{name}_batch_scaling.csv":
+                    _write_csv(p, rows),
+            )
             sweeps[name] = sweep
             for r in sweep:
                 print(f"[baseline] scaling {json.dumps(r)}")

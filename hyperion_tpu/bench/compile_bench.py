@@ -138,7 +138,7 @@ def bench_variant(
     }
 
 
-def run(models, dtype: str, iters: int) -> list[dict]:
+def run(models, dtype: str, iters: int, sink=None) -> list[dict]:
     rows = []
     for name in models:
         for variant in VARIANTS:
@@ -152,11 +152,14 @@ def run(models, dtype: str, iters: int) -> list[dict]:
                     "note": f"failed: {str(e).splitlines()[0][:80]}",
                 }
             rows.append(r)
+            if sink is not None:
+                sink(r)
             print(f"[compile_bench] {json.dumps(r)}")
     return rows
 
 
-def train_step_rows(dtype: str, seq: int = 1024, batch: int = 4) -> list[dict]:
+def train_step_rows(dtype: str, seq: int = 1024, batch: int = 4,
+                    sink=None) -> list[dict]:
     """Full train step (fwd+bwd+opt) at long sequence, jit vs
     jit+pallas — where flash attention's O(T) memory vs the XLA path's
     [B, H, T, T] logits shows up in both time and peak memory."""
@@ -211,6 +214,8 @@ def train_step_rows(dtype: str, seq: int = 1024, batch: int = 4) -> list[dict]:
                 "temp_memory_gb": float("nan"), "iters": 0,
                 "note": f"failed: {str(e).splitlines()[0][:80]}",
             })
+        if sink is not None:
+            sink(rows[-1])
         print(f"[compile_bench] {json.dumps(rows[-1])}")
     return rows
 
@@ -254,17 +259,27 @@ def main(argv=None) -> None:
     args = p.parse_args(argv)
 
     dtype = {"fp32": "float32", "bf16": "bfloat16"}[args.dtype]
-    rows = run(args.models, dtype, args.repeat)
-    if args.train_step:
-        rows += train_step_rows(dtype, args.train_seq, args.train_batch)
-
     out = Path(args.out)
     out.mkdir(parents=True, exist_ok=True)
-    with (out / "compilation_benchmark.csv").open("w", newline="") as f:
-        w = csv.DictWriter(f, fieldnames=list(rows[0]))
-        w.writeheader()
-        w.writerows(rows)
-    (out / "compilation_benchmark.json").write_text(json.dumps(rows, indent=2))
+
+    # incremental flush: a cold compile over the tunnel can blow the
+    # capture stage's time limit — every row already measured must be on
+    # disk when SIGTERM lands, not in this process's memory
+    flushed: list[dict] = []
+
+    def sink(row: dict) -> None:
+        flushed.append(row)
+        with (out / "compilation_benchmark.csv").open("w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(flushed[0]))
+            w.writeheader()
+            w.writerows(flushed)
+        (out / "compilation_benchmark.json").write_text(
+            json.dumps(flushed, indent=2))
+
+    rows = run(args.models, dtype, args.repeat, sink=sink)
+    if args.train_step:
+        rows += train_step_rows(dtype, args.train_seq, args.train_batch,
+                                sink=sink)
     from hyperion_tpu.metrics.plots import plot_compile_tiers, try_plot
 
     try_plot(plot_compile_tiers, rows, out / "compilation_benchmark.png")

@@ -15,6 +15,7 @@ OUT=results/benchmarks
 RUNS=results/tpu_runs
 mkdir -p "$OUT" "$RUNS"
 export JAX_PLATFORMS=""   # never inherit a test shell's cpu pin
+export PYTHONUNBUFFERED=1 # piped stdout: progress visible + survives SIGTERM
 # Warm-compile persistence across stages and retries: a cold train-step
 # compile over the tunnel can exceed a child timeout; the cache makes the
 # second attempt (watcher retry / round-end driver bench) near-instant.

@@ -118,10 +118,13 @@ def compile_table(root: Path) -> None:
 def headline(root: Path) -> None:
     p = root / "bench_live.json"
     lines = p.read_text().strip().splitlines() if p.exists() else []
-    if not lines:  # missing OR truncated by a killed capture run
+    try:  # missing, empty, OR a partial fragment from a killed capture
+        doc = json.loads(lines[-1]) if lines else None
+    except json.JSONDecodeError:
+        doc = None
+    if doc is None:
         print("(bench_live.json not captured yet)\n")
         return
-    doc = json.loads(lines[-1])
     print(f"headline: {doc.get('value')} {doc.get('unit')} "
           f"(vs_baseline {doc.get('vs_baseline')}, mfu {doc.get('mfu')}, "
           f"device {doc.get('device_kind')})")
@@ -144,13 +147,18 @@ def training_table(runs: Path) -> None:
         return
     for f in sorted(d.glob("*_metrics.csv")):
         rows = _read(f)
-        if not rows:
+        durs = []
+        for r in rows[1:] or rows:  # a SIGTERM mid-write can truncate the
+            try:                    # final row — skip it, keep the rest
+                durs.append(float(r["duration_s"]))
+            except (KeyError, TypeError, ValueError):
+                continue
+        if not durs:
             continue
-        durs = sorted(float(r["duration_s"]) for r in rows[1:] or rows)
-        med = durs[len(durs) // 2]
+        med = sorted(durs)[len(durs) // 2]
         last = rows[-1]
         cols = {k: last[k] for k in ("epoch", "loss", "val_loss", "val_accuracy")
-                if k in last and last[k] not in ("", None)}
+                if last.get(k) not in ("", None)}
         print(f"{f.name}: {len(rows)} epochs, median epoch {med:.2f}s, "
               f"final {cols}")
     for f in sorted(d.glob("*_summary.json")):
