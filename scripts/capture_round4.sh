@@ -40,6 +40,15 @@ commit() {  # commit <msg> <paths...> — retries around concurrent commits
 FAILED=0
 run() {  # run <timeout_s> <label> <cmd...>
   local t="$1" label="$2"; shift 2
+  # Re-probe before every stage: a tunnel that died mid-capture must
+  # fail the remaining stages in ~2 min each via exit 2 (watcher
+  # retries), not burn each stage's full multi-hour time limit blocked
+  # inside backend init.
+  if ! probe >/dev/null 2>&1; then
+    echo "[capture] tunnel down before $label — aborting for retry" >&2
+    FAILED=$((FAILED + 1))
+    return 1
+  fi
   echo "[capture] === $label ($(date -u +%FT%TZ), limit ${t}s) ==="
   timeout "$t" "$@"
   local rc=$?
@@ -69,7 +78,7 @@ fi
 #    tunnel dies again. bench_live.json only ever holds a GOOD headline
 #    (bench.py's last_committed fallback reads it from HEAD): a failure
 #    line lands in bench_live_latest.json but never overwrites it.
-run 1800 bench.py bash -c "python bench.py | tee $OUT/bench_live_latest.json"
+if run 1800 bench.py bash -c "python bench.py | tee $OUT/bench_live_latest.json"; then
 python - <<'EOF' || FAILED=$((FAILED + 1))
 import json, sys, shutil
 try:
@@ -88,6 +97,7 @@ else:
     print("[capture] headline failed/zero; bench_live.json untouched")
     sys.exit(1)
 EOF
+fi
 commit "Real-chip capture: headline bench (bf16 matmul + LM step)" "$OUT"
 
 # 2. Model-level baseline: fwd/bwd/opt decomposition, batch scaling,
