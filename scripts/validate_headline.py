@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Promote a good live headline to bench_live.json (capture stage 1).
+
+Reads results/benchmarks/bench_live_latest.json (just written by
+`python bench.py | tee ...`); if its last line parses and carries a
+truthy `value`, copies it over bench_live.json — the file bench.py's
+`last_committed` fallback reads from HEAD. A zero/failed headline exits
+1 so the capture stage counts as failed and the watcher retries; the
+committed bench_live.json is never overwritten with a failure line.
+"""
+
+import json
+import shutil
+import sys
+
+LATEST = "results/benchmarks/bench_live_latest.json"
+GOOD = "results/benchmarks/bench_live.json"
+
+try:
+    doc = json.loads(open(LATEST).read().strip().splitlines()[-1])
+except Exception as e:  # noqa: BLE001 — missing/truncated both mean "not updated"
+    print(f"[capture] bench_live.json not updated: {e}")
+    sys.exit(1)
+if doc.get("value"):
+    shutil.copy(LATEST, GOOD)
+    print("[capture] headline is good; bench_live.json updated")
+else:
+    print("[capture] headline failed/zero; bench_live.json untouched")
+    sys.exit(1)
