@@ -37,7 +37,10 @@ from hyperion_tpu.utils.timing import time_chained
 MATMUL_SIZES = (1024, 2048, 4096, 8192)
 # fp16 included for column parity with the reference sweep; on TPU the
 # MXU's native reduced precision is bf16 and fp16 routes through it.
-MATMUL_DTYPES = ("float32", "bfloat16", "float16")
+# int8 exceeds the reference sweep (no quantized path there — SURVEY
+# C21): the v5e MXU's int8 peak is 2x bf16, the capability behind
+# `precision/quant.py`.
+MATMUL_DTYPES = ("float32", "bfloat16", "float16", "int8")
 BANDWIDTH_ELEMS = (10_000_000, 50_000_000, 100_000_000, 250_000_000, 500_000_000)
 BYTES_PER_ELEM = 12  # 2 fp32 reads + 1 write — the reference's accounting
 
@@ -62,22 +65,43 @@ def matmul_tflops(
     rows = []
     for size in sizes:
         for dtype in dtypes:
-            dt = jnp.dtype(dtype)
             k0, k1 = jax.random.split(jax.random.key(size))
-            a = jax.random.normal(k0, (size, size), dt)
-            # unit-scale normalization folded into B outside the chain so
-            # the timed iteration is a pure matmul — no per-iteration
-            # elementwise epilogue (it cost real HBM traffic at 8192^2)
-            b = jax.random.normal(k1, (size, size), dt) * jnp.asarray(
-                1.0 / size**0.5, dt
-            )
-            # fp32 inputs default to one bf16 MXU pass on TPU; request
-            # true-fp32 precision so the column means what the
-            # reference's real-fp32 measurement meant (36.44 TFLOPS)
-            prec = jax.lax.Precision.HIGHEST if dtype == "float32" else None
+            if dtype == "int8":
+                # int8 x int8 -> int32 on the MXU; the chain requantizes
+                # the carry back to int8 (as real quantized inference
+                # does between layers). The epilogue is elementwise on
+                # the output, so XLA fuses it into the matmul — the
+                # int32 intermediate never round-trips HBM. `inv` keeps
+                # the carry's spread at the operands' (~uniform int8).
+                a = jax.random.randint(k0, (size, size), -127, 128, jnp.int8)
+                b = jax.random.randint(k1, (size, size), -127, 128, jnp.int8)
+                inv = jnp.float32(1.0 / (size**0.5 * 73.0))
 
-            def mm(c, b):
-                return jnp.matmul(c, b, precision=prec)
+                def mm(c, b):
+                    acc = jax.lax.dot_general(
+                        c, b, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32,
+                    )
+                    return jnp.clip(
+                        jnp.round(acc.astype(jnp.float32) * inv), -127, 127
+                    ).astype(jnp.int8)
+            else:
+                dt = jnp.dtype(dtype)
+                a = jax.random.normal(k0, (size, size), dt)
+                # unit-scale normalization folded into B outside the
+                # chain so the timed iteration is a pure matmul — no
+                # per-iteration elementwise epilogue (it cost real HBM
+                # traffic at 8192^2)
+                b = jax.random.normal(k1, (size, size), dt) * jnp.asarray(
+                    1.0 / size**0.5, dt
+                )
+                # fp32 inputs default to one bf16 MXU pass on TPU;
+                # request true-fp32 precision so the column means what
+                # the reference's real-fp32 measurement meant (36.44)
+                prec = jax.lax.Precision.HIGHEST if dtype == "float32" else None
+
+                def mm(c, b):
+                    return jnp.matmul(c, b, precision=prec)
 
             t = time_chained(mm, a, b, k1=8, k2=24, n_thread=1)
             tflops = (2 * size**3 / (t.per_iter_ms / 1e3)) / 1e12

@@ -1,2 +1,10 @@
 from hyperion_tpu.precision.policy import Policy, get_policy  # noqa: F401
+from hyperion_tpu.precision.quant import (  # noqa: F401
+    dequantize,
+    dequantize_tree,
+    int8_matmul,
+    quantize_int8,
+    quantize_tree,
+    quantized_dense,
+)
 from hyperion_tpu.precision.remat import apply_remat, REMAT_POLICIES  # noqa: F401

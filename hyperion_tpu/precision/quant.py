@@ -1,0 +1,119 @@
+"""Symmetric int8 quantization — the v5e's other 2x.
+
+Beyond reference parity: the MI250X project stops at fp16/bf16 AMP
+(SURVEY C21, `mixed_precision.ipynb`); it has no quantized path. On TPU
+v5e the MXU's int8 peak is 2x bf16 (394 vs 197 TOPS/TFLOPS —
+`utils/chips.py`), and weight-only int8 additionally halves the HBM
+traffic that bounds decode. This module is the TPU-native way in:
+
+  * `quantize_int8(x, axis)` — symmetric per-axis quantization: int8
+    values plus an fp32 scale broadcastable against them. `axis` is the
+    CONTRACTION axis of the matmul the tensor is headed for, so the
+    scale factors out of the dot exactly (per-row for activations,
+    per-column for a [K, N] weight).
+  * `int8_matmul(xq, wq, sx, sw)` — int8 x int8 -> int32 accumulation
+    on the MXU (`preferred_element_type`), rescaled to float on the way
+    out. XLA fuses the dequant epilogue into the matmul output, so the
+    int32 intermediate never round-trips HBM.
+  * `quantized_dense(x, wq, sw)` — dynamic-activation path: quantize
+    the float activations per row at run time, multiply in int8,
+    dequantize. Drop-in for `x @ w`.
+  * `quantize_tree(params)` — walk a params pytree and quantize every
+    2-D `kernel` leaf, returning the quantized tree (int8 + scales)
+    for weight-only-int8 inference; `dequantize_tree` restores floats
+    (for layers the caller wants back in bf16).
+
+Numerics: symmetric round-to-nearest, clip to [-127, 127] (keeping
+-128 out keeps the scale exactly representable and the error bound
+symmetric). Per-channel error for unit-variance data is ~0.4% RMS —
+tests assert the bound. Training stays bf16 (`precision/policy.py`);
+int8 is an inference-time transform, which is also why it lives beside
+the AMP policy rather than inside the models.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-8
+
+
+def quantize_int8(x: jax.Array, axis: int = -1) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-axis int8 quantization.
+
+    Returns `(q, scale)` with `q` int8 and `scale` fp32, shaped like `x`
+    with `axis` reduced to 1 (broadcastable: `q * scale ~= x`). Pass the
+    matmul's contraction axis so the scale factors out of the dot.
+    """
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, _EPS) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize(q: jax.Array, scale: jax.Array,
+               dtype: jnp.dtype | str = jnp.float32) -> jax.Array:
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def int8_matmul(
+    xq: jax.Array, wq: jax.Array, sx: jax.Array, sw: jax.Array,
+    out_dtype: jnp.dtype | str = jnp.bfloat16,
+) -> jax.Array:
+    """`dequant(xq) @ dequant(wq)` computed as int8 x int8 on the MXU.
+
+    `xq` [..., M, K] int8 with per-row scale `sx` [..., M, 1];
+    `wq` [K, N] int8 with per-column scale `sw` [1, N]. Because both
+    scales are constant along K they factor out of the contraction:
+    the int32 accumulator is exact, and one fused epilogue multiply
+    recovers the float result.
+    """
+    acc = jax.lax.dot_general(
+        xq, wq, (((xq.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (acc.astype(jnp.float32) * sx * sw).astype(out_dtype)
+
+
+def quantized_dense(
+    x: jax.Array, wq: jax.Array, sw: jax.Array,
+    out_dtype: jnp.dtype | str | None = None,
+) -> jax.Array:
+    """Drop-in `x @ w` with a pre-quantized weight: dynamic per-row
+    activation quantization, int8 MXU matmul, float out."""
+    xq, sx = quantize_int8(x, axis=-1)
+    return int8_matmul(xq, wq, sx, sw, out_dtype or x.dtype)
+
+
+def _is_quantizable(path: tuple, leaf: jax.Array) -> bool:
+    name = str(path[-1].key) if path and hasattr(path[-1], "key") else ""
+    return name == "kernel" and getattr(leaf, "ndim", 0) == 2
+
+
+def quantize_tree(params) -> dict:
+    """Weight-only int8: every 2-D `kernel` leaf becomes
+    `{"q": int8, "scale": fp32}` (per-output-column, i.e. contraction
+    axis 0); everything else passes through unchanged."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    leaves = []
+    for path, leaf in flat:
+        if _is_quantizable(path, leaf):
+            q, scale = quantize_int8(leaf, axis=0)
+            leaves.append({"q": q, "scale": scale})
+        else:
+            leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def dequantize_tree(qparams, dtype: jnp.dtype | str = jnp.bfloat16):
+    """Invert `quantize_tree` (up to quantization error)."""
+
+    def is_qleaf(x):
+        return isinstance(x, dict) and set(x) == {"q", "scale"}
+
+    return jax.tree_util.tree_map(
+        lambda x: dequantize(x["q"], x["scale"], dtype) if is_qleaf(x) else x,
+        qparams, is_leaf=is_qleaf,
+    )

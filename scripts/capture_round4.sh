@@ -164,7 +164,7 @@ commit "Real-chip capture: llama-tiny LoRA convergence run" "$RUNS"
 # 10. MFU chain-variant probe (VERDICT r3 weak #1): which chain shape
 #     closes the 8192^2 gap to peak. Informs bench.py/hw_explore tuning.
 stage 1800 mfu_probe bash -c \
-  "python scripts/mfu_probe.py | tee $OUT/hardware/mfu_probe.json"
+  "set -o pipefail; python scripts/mfu_probe.py | tee $OUT/hardware/mfu_probe.json"
 commit "Real-chip capture: MFU chain-variant probe at 8192^2" "$OUT"
 
 echo "[capture] artifacts:"

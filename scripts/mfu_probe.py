@@ -24,7 +24,13 @@ from __future__ import annotations
 import argparse
 import functools
 import json
+import os
+import sys
 import time
+
+# run as `python scripts/mfu_probe.py`: script dir, not the repo root,
+# is sys.path[0] — add the root so hyperion_tpu imports
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:
@@ -135,7 +141,9 @@ def main() -> None:
 
     results["dimnum"] = tflops(slope(build_dimnum))
 
-    peak = 197.0 if jax.devices()[0].platform == "tpu" else None
+    from hyperion_tpu.utils.chips import nominal_peak_tflops
+
+    peak = nominal_peak_tflops("bfloat16")
     doc = {
         "size": n, "k": k,
         "tflops": {v: round(t, 2) for v, t in results.items()},
