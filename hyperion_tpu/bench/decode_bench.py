@@ -40,12 +40,21 @@ MODEL_SPECS = {
 
 def benchmark_decode(
     name: str, batch: int = 8, prompt_len: int = 128, decode_len: int = 64,
+    quant: str = "none",
 ) -> dict:
     cfg = llama_tiny_config(**MODEL_SPECS[name])  # tiny base + overrides
     model = Llama(cfg)
     params = jax.jit(
         lambda r: model.init_params(r, seq=min(8, cfg.max_len))
     )(jax.random.key(0))
+    if quant == "int8":
+        # weight-only int8 (precision/quant.py): kernels become int8 +
+        # per-channel scales — half bf16's weight HBM traffic, which is
+        # the bound in decode; the int8 x int8 matmuls run on the MXU
+        from hyperion_tpu.precision.quant import quantize_llama
+
+        model, params = quantize_llama(params, cfg)
+        cfg = model.cfg
     variables = {"params": params}
     ids = jnp.asarray(
         np.random.default_rng(0).integers(1, cfg.vocab_size, (batch, prompt_len)),
@@ -100,6 +109,7 @@ def benchmark_decode(
     decode_live_mb = live_bytes_in_use() / 1e6
     return {
         "model": name,
+        "quant": quant,
         "batch": batch,
         "prompt_len": prompt_len,
         "prefill_ms": round(t_prefill.median_ms, 3),
@@ -118,30 +128,42 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--models", nargs="*", default=["tiny", "mid"],
                    choices=sorted(MODEL_SPECS))
+    p.add_argument("--quant", nargs="*", default=["none", "int8"],
+                   choices=["none", "int8"],
+                   help="weight variants per model (int8 = weight-only "
+                        "quantized decode, precision/quant.py)")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--decode-len", type=int, default=64)
     p.add_argument("--out", default="results/benchmarks/decode")
     args = p.parse_args(argv)
 
+    out = Path(args.out)
     rows = []
-    for name in args.models:
-        try:
-            r = benchmark_decode(
-                name, args.batch, args.prompt_len, args.decode_len
-            )
-        except Exception as e:  # one model's OOM must not kill the sweep
-            print(f"[decode_bench] {name} failed: {str(e).splitlines()[0]}")
-            continue
-        rows.append(r)
-        print(f"[decode_bench] {json.dumps(r)}")
-    if rows:
-        out = Path(args.out)
+
+    def flush() -> None:
+        # incremental: rows measured before a capture-stage SIGTERM stay
         out.mkdir(parents=True, exist_ok=True)
         with (out / "decode_benchmarks.csv").open("w", newline="") as f:
             w = csv.DictWriter(f, fieldnames=list(rows[0]))
             w.writeheader()
             w.writerows(rows)
+
+    for name in args.models:
+        for quant in args.quant:
+            try:
+                r = benchmark_decode(
+                    name, args.batch, args.prompt_len, args.decode_len,
+                    quant=quant,
+                )
+            except Exception as e:  # one model's OOM must not kill the sweep
+                print(f"[decode_bench] {name}/{quant} failed: "
+                      f"{str(e).splitlines()[0]}")
+                continue
+            rows.append(r)
+            flush()
+            print(f"[decode_bench] {json.dumps(r)}")
+    if rows:
         print(f"[decode_bench] results in {out}/")
 
 

@@ -239,11 +239,22 @@ def main(argv=None) -> int:
     p.add_argument("--max-len", type=int, default=4096,
                    help="context length for Llama exports (RoPE has no "
                         "weight table to infer it from)")
+    p.add_argument("--quant", choices=["none", "int8"], default="none",
+                   help="int8 = weight-only quantized decode "
+                        "(Llama exports only; precision/quant.py)")
     args = p.parse_args(argv)
 
     tok = ByteBPE.load(args.tokenizer_dir)
     params = load_gathered(args.ckpt)
     model, cached = model_from_npz(params, args.max_len)
+    if args.quant == "int8":
+        if not cached:
+            raise SystemExit(
+                "--quant int8 currently supports Llama exports only"
+            )
+        from hyperion_tpu.precision.quant import quantize_llama
+
+        model, params = quantize_llama(params, model.cfg)
     decode = generate if cached else generate_recompute
     ids = jnp.asarray([tok.encode(args.prompt)], jnp.int32)
     out = decode(

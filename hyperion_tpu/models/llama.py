@@ -50,6 +50,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     attention_impl: str = "xla"
     norm_impl: str = "xla"        # xla | pallas (fused_rmsnorm kernel)
+    # "none" | "int8": weight-only int8 inference (precision/quant.py) —
+    # dense kernels become int8+scale (half bf16's HBM traffic, int8
+    # MXU matmuls); params come from quantize_params_for() on a trained
+    # float checkpoint. Inference-only: train float, then quantize.
+    quant: str = "none"
     # 7B needs remat on any realistic chip; False/"none", True/"full",
     # or a named precision.remat policy ("dots", "dots_no_batch")
     remat: bool | str = True
@@ -83,6 +88,24 @@ def llama_tiny_config(**kw) -> LlamaConfig:
     )
     base.update(kw)
     return LlamaConfig(**base)
+
+
+def _dense_ctor(c: LlamaConfig):
+    """The dense-layer constructor for this config: float
+    `nn.DenseGeneral` normally, `QuantDenseGeneral` (weight-only int8)
+    when `c.quant == "int8"`. `nn.DenseGeneral(features=int, axis=-1)`
+    is exactly `nn.Dense` (same `kernel` leaf name and shape), so
+    checkpoints are unaffected by routing everything through one ctor."""
+    if c.quant == "int8":
+        from hyperion_tpu.precision.quant import QuantDenseGeneral
+
+        return partial(QuantDenseGeneral, dtype=c.compute_dtype)
+    if c.quant != "none":
+        raise ValueError(f"unknown quant mode {c.quant!r}")
+    return partial(
+        nn.DenseGeneral, use_bias=False, dtype=c.compute_dtype,
+        kernel_init=nn.initializers.normal(0.02),
+    )
 
 
 class RMSNorm(nn.Module):
@@ -156,10 +179,7 @@ class LlamaAttention(nn.Module):
         the filled prefix (dense left-to-right prompts only — no
         padding_mask in the cached path)."""
         c = self.cfg
-        dense = partial(
-            nn.DenseGeneral, use_bias=False, dtype=c.compute_dtype,
-            kernel_init=nn.initializers.normal(0.02),
-        )
+        dense = _dense_ctor(c)
         q = dense(features=(c.n_heads, c.head_dim), name="q_proj")(x)
         k = dense(features=(c.n_kv_heads, c.head_dim), name="k_proj")(x)
         v = dense(features=(c.n_kv_heads, c.head_dim), name="v_proj")(x)
@@ -206,13 +226,10 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         c = self.cfg
-        dense = partial(
-            nn.Dense, use_bias=False, dtype=c.compute_dtype,
-            kernel_init=nn.initializers.normal(0.02),
-        )
-        gate = dense(c.ff_dim, name="gate_proj")(x)
-        up = dense(c.ff_dim, name="up_proj")(x)
-        return dense(c.d_model, name="down_proj")(nn.silu(gate) * up)
+        dense = _dense_ctor(c)
+        gate = dense(features=c.ff_dim, name="gate_proj")(x)
+        up = dense(features=c.ff_dim, name="up_proj")(x)
+        return dense(features=c.d_model, name="down_proj")(nn.silu(gate) * up)
 
 
 class LlamaBlock(nn.Module):
@@ -283,10 +300,7 @@ class Llama(nn.Module):
                 x, layer_cache = blk(x, rope, None, cache[i], cache_index)
                 new_cache.append(layer_cache)
         x = RMSNorm(c.norm_eps, c.compute_dtype, c.norm_impl, name="final_norm")(x)
-        logits = nn.Dense(
-            c.vocab_size, use_bias=False, dtype=c.compute_dtype,
-            kernel_init=nn.initializers.normal(0.02), name="lm_head",
-        )(x)
+        logits = _dense_ctor(c)(features=c.vocab_size, name="lm_head")(x)
         logits = logits.astype(jnp.float32)
         return logits if cache is None else (logits, new_cache)
 

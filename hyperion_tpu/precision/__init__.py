@@ -1,10 +1,12 @@
 from hyperion_tpu.precision.policy import Policy, get_policy  # noqa: F401
 from hyperion_tpu.precision.quant import (  # noqa: F401
+    QuantDenseGeneral,
     dequantize,
-    dequantize_tree,
+    dequantize_params,
     int8_matmul,
     quantize_int8,
-    quantize_tree,
+    quantize_llama,
+    quantize_params_like,
     quantized_dense,
 )
 from hyperion_tpu.precision.remat import apply_remat, REMAT_POLICIES  # noqa: F401

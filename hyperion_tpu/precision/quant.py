@@ -18,10 +18,14 @@ traffic that bounds decode. This module is the TPU-native way in:
   * `quantized_dense(x, wq, sw)` — dynamic-activation path: quantize
     the float activations per row at run time, multiply in int8,
     dequantize. Drop-in for `x @ w`.
-  * `quantize_tree(params)` — walk a params pytree and quantize every
-    2-D `kernel` leaf, returning the quantized tree (int8 + scales)
-    for weight-only-int8 inference; `dequantize_tree` restores floats
-    (for layers the caller wants back in bf16).
+  * `QuantDenseGeneral` — the flax layer: `nn.DenseGeneral` reading an
+    int8 `kernel_q` + fp32 `kernel_scale` instead of a float kernel.
+    `models.llama.LlamaConfig(quant="int8")` routes every dense
+    through it.
+  * `quantize_llama(params, cfg)` / `quantize_params_like` — convert a
+    trained float checkpoint to that layout, deriving each kernel's
+    contraction axes from the quant model's own shape tree;
+    `dequantize_params` restores floats.
 
 Numerics: symmetric round-to-nearest, clip to [-127, 127] (keeping
 -128 out keeps the scale exactly representable and the error bound
@@ -33,18 +37,23 @@ the AMP policy rather than inside the models.
 
 from __future__ import annotations
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 _EPS = 1e-8
 
 
-def quantize_int8(x: jax.Array, axis: int = -1) -> tuple[jax.Array, jax.Array]:
+def quantize_int8(
+    x: jax.Array, axis: int | tuple[int, ...] = -1
+) -> tuple[jax.Array, jax.Array]:
     """Symmetric per-axis int8 quantization.
 
     Returns `(q, scale)` with `q` int8 and `scale` fp32, shaped like `x`
     with `axis` reduced to 1 (broadcastable: `q * scale ~= x`). Pass the
-    matmul's contraction axis so the scale factors out of the dot.
+    matmul's contraction axis (or axes — e.g. an o_proj kernel
+    [H, D, d] contracts over (0, 1)) so the scale factors out of the dot.
     """
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
     scale = jnp.maximum(amax, _EPS) / 127.0
@@ -86,34 +95,138 @@ def quantized_dense(
     return int8_matmul(xq, wq, sx, sw, out_dtype or x.dtype)
 
 
-def _is_quantizable(path: tuple, leaf: jax.Array) -> bool:
-    name = str(path[-1].key) if path and hasattr(path[-1], "key") else ""
-    return name == "kernel" and getattr(leaf, "ndim", 0) == 2
+# --- weight-only int8 as a flax layer (the model-integration path) ------
 
 
-def quantize_tree(params) -> dict:
-    """Weight-only int8: every 2-D `kernel` leaf becomes
-    `{"q": int8, "scale": fp32}` (per-output-column, i.e. contraction
-    axis 0); everything else passes through unchanged."""
-    flat = jax.tree_util.tree_flatten_with_path(params)[0]
-    treedef = jax.tree_util.tree_structure(params)
-    leaves = []
-    for path, leaf in flat:
-        if _is_quantizable(path, leaf):
-            q, scale = quantize_int8(leaf, axis=0)
-            leaves.append({"q": q, "scale": scale})
-        else:
-            leaves.append(leaf)
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+class QuantDenseGeneral(nn.Module):
+    """`nn.DenseGeneral(use_bias=False)` reading an int8 kernel.
+
+    Drop-in for the dense call shapes the models use: `features` may be
+    an int or a tuple (q/k/v project to `(n_heads, head_dim)`), `axis`
+    may be -1 or a trailing tuple (o_proj contracts `(-2, -1)`). Params
+    are `kernel_q` (int8, the float kernel's shape) and `kernel_scale`
+    (fp32, contraction axes reduced to 1) — produced from a trained
+    float checkpoint by `quantize_params_for` / `quantize_tree`; the
+    zero-init here is a placeholder for shape/structure only (PTQ loads
+    real weights, it never trains them).
+
+    The matmul itself runs via `quantized_dense`: dynamic per-row
+    activation quantization, int8 x int8 -> int32 on the MXU, fused
+    dequant epilogue. Weight HBM traffic is 1 byte/elem — half of bf16 —
+    which is the win where decode is bandwidth-bound.
+    """
+
+    features: int | tuple[int, ...]
+    axis: int | tuple[int, ...] = -1
+    dtype: jnp.dtype | str = jnp.bfloat16
+    use_bias: bool = False  # signature parity; bias unsupported
+
+    @nn.compact
+    def __call__(self, x):
+        if self.use_bias:
+            raise NotImplementedError("QuantDenseGeneral is bias-free")
+        feats = (self.features,) if isinstance(self.features, int) \
+            else tuple(self.features)
+        axes = (self.axis,) if isinstance(self.axis, int) else tuple(self.axis)
+        axes = tuple(a % x.ndim for a in axes)
+        if axes != tuple(range(x.ndim - len(axes), x.ndim)):
+            raise ValueError(f"contraction axes must be trailing, got {axes}")
+        in_shape = tuple(x.shape[a] for a in axes)
+        kshape = in_shape + feats
+        kq = self.param("kernel_q", nn.initializers.zeros, kshape, jnp.int8)
+        ks = self.param(
+            "kernel_scale", nn.initializers.ones,
+            (1,) * len(in_shape) + feats, jnp.float32,
+        )
+        in_dim = int(np.prod(in_shape))
+        out_dim = int(np.prod(feats))
+        lead = x.shape[: x.ndim - len(axes)]
+        out = quantized_dense(
+            x.reshape(*lead, in_dim),
+            kq.reshape(in_dim, out_dim),
+            ks.reshape(1, out_dim),
+            out_dtype=self.dtype,
+        )
+        return out.reshape(*lead, *feats)
 
 
-def dequantize_tree(qparams, dtype: jnp.dtype | str = jnp.bfloat16):
-    """Invert `quantize_tree` (up to quantization error)."""
+def quantize_params_like(params, quant_shapes):
+    """Convert a trained float param tree to the `QuantDenseGeneral`
+    layout: wherever `quant_shapes` (the QUANT model's own param tree,
+    typically from `jax.eval_shape` of its init — shapes only, no
+    memory) holds `kernel_q`/`kernel_scale` siblings, the float
+    `kernel` is quantized over the contraction axes read off the
+    target `kernel_scale` shape (`QuantDenseGeneral` writes it as
+    `(1,) * n_contract + features`). Everything else passes through —
+    norms, biases and embeddings stay float, exactly the weight-only
+    recipe — so the result loads wherever the quant model's init does,
+    with no hand-maintained per-layer axis table to drift.
+    """
 
-    def is_qleaf(x):
-        return isinstance(x, dict) and set(x) == {"q", "scale"}
+    def walk(node, target):
+        if not isinstance(node, dict) or not isinstance(target, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if k == "kernel" and "kernel_q" in target:
+                # leading 1s of the scale shape ARE the contraction axes
+                # (`QuantDenseGeneral` writes (1,)*n_contract + features);
+                # stop before the last dim so a size-1 feature can't be
+                # mistaken for a contraction axis
+                sshape = tuple(target["kernel_scale"].shape)
+                nc = 0
+                while nc < len(sshape) - 1 and sshape[nc] == 1:
+                    nc += 1
+                q, s = quantize_int8(v, axis=tuple(range(nc)))
+                if q.shape != tuple(target["kernel_q"].shape):
+                    raise ValueError(
+                        f"kernel shape {q.shape} != quant model's "
+                        f"{tuple(target['kernel_q'].shape)}"
+                    )
+                out["kernel_q"] = q
+                out["kernel_scale"] = s
+            else:
+                out[k] = walk(v, target.get(k, {}))
+        return out
 
-    return jax.tree_util.tree_map(
-        lambda x: dequantize(x["q"], x["scale"], dtype) if is_qleaf(x) else x,
-        qparams, is_leaf=is_qleaf,
+    return walk(params, quant_shapes)
+
+
+def quantize_llama(params, cfg):
+    """Weight-only int8 for a Llama checkpoint: returns
+    `(quant_model, quant_params)` ready for `infer.generate`.
+
+    `params` is the trained float tree for `models.llama.Llama(cfg)`;
+    the returned model is the same architecture with
+    `cfg.quant = "int8"` and params in the `QuantDenseGeneral` layout.
+    """
+    import dataclasses
+
+    from hyperion_tpu.models.llama import Llama  # lazy: avoid a cycle
+
+    qmodel = Llama(dataclasses.replace(cfg, quant="int8"))
+    shapes = jax.eval_shape(
+        lambda r: qmodel.init_params(r, batch=1, seq=min(8, cfg.max_len)),
+        jax.random.key(0),
     )
+    return qmodel, quantize_params_like(params, shapes)
+
+
+def dequantize_params(qparams, dtype: jnp.dtype | str = jnp.bfloat16):
+    """Invert `quantize_params_like` (up to quantization error):
+    `kernel_q`/`kernel_scale` siblings fold back into a float `kernel`."""
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if k == "kernel_q":
+                out["kernel"] = dequantize(v, node["kernel_scale"], dtype)
+            elif k == "kernel_scale":
+                continue
+            else:
+                out[k] = walk(v)
+        return out
+
+    return walk(qparams)

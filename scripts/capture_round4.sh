@@ -126,7 +126,7 @@ stage 2400 compile_bench python -m hyperion_tpu.bench.compile_bench \
 commit "Real-chip capture: compile-tier benchmark (C14)" "$OUT"
 
 # 4. Decode throughput/memory (no reference counterpart; pure headroom).
-stage 1200 decode_bench python -m hyperion_tpu.bench.decode_bench --out "$OUT/decode"
+stage 1800 decode_bench python -m hyperion_tpu.bench.decode_bench --out "$OUT/decode"
 commit "Real-chip capture: decode benchmark" "$OUT"
 
 # 5-6. Real training runs at the reference's epoch counts (VERDICT

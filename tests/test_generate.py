@@ -171,3 +171,24 @@ class TestGenerationCLI:
             "--max-new-tokens", "4",
         ])
         assert rc == 0
+
+    @pytest.mark.slow
+    def test_main_quant_int8_llama(self, tmp_path):
+        """Llama export -> --quant int8 weight-only decode via the CLI."""
+        from hyperion_tpu.checkpoint.io import export_gathered
+        from hyperion_tpu.data.bpe import train_bpe
+        from hyperion_tpu.infer.generate import main
+        from hyperion_tpu.models.llama import Llama, llama_tiny_config
+
+        tok = train_bpe(["the quick brown fox"] * 4, vocab_size=300,
+                        verbose=False)
+        tok.save(tmp_path / "tok")
+        cfg = llama_tiny_config(vocab_size=tok.vocab_size, max_len=32)
+        params = Llama(cfg).init_params(jax.random.key(0), seq=8)
+        export_gathered(tmp_path / "llama.npz", params)
+        rc = main([
+            "--prompt", "the quick", "--ckpt", str(tmp_path / "llama.npz"),
+            "--tokenizer-dir", str(tmp_path / "tok"),
+            "--max-new-tokens", "4", "--max-len", "32", "--quant", "int8",
+        ])
+        assert rc == 0
