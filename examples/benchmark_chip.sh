@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Reproduce the perf story on your chip: hardware sweep, model
+# baselines, compile tiers, decode throughput, headline JSON line.
+#
+#   examples/benchmark_chip.sh [outdir]
+#
+# Every suite uses chained data-dependent iterations fenced by a host
+# fetch (utils/timing.py) — a lazy backend yields a rejected
+# measurement, never a fake number. Compare against the MI250X
+# reference rows with scripts/compare_to_reference.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT="${1:-results/benchmarks_local}"
+
+python -m hyperion_tpu.bench.hw_explore --out "$OUT/hardware"
+python -m hyperion_tpu.bench.baseline --scaling \
+  --precisions float32 bfloat16 --out "$OUT/baseline"
+python -m hyperion_tpu.bench.compile_bench --train-step --out "$OUT/compilation"
+python -m hyperion_tpu.bench.decode_bench --out "$OUT/decode"
+python bench.py
+
+python scripts/compare_to_reference.py --root "$OUT"

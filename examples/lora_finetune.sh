@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# LoRA fine-tune a Llama, export base+adapters merged, and generate —
+# including weight-only int8 decode.
+#
+#   examples/lora_finetune.sh [workdir] [size]
+#
+# size: tiny (default — runs anywhere) or 7b (one v5e chip with the
+# auto-enabled full remat; put local HF weights in <workdir>/llama2_hf
+# to start from Llama-2 instead of random init).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+WORK="${1:-data/example_lora}"
+SIZE="${2:-tiny}"
+
+# 1. Fine-tune: frozen base + r16/a32 adapters on q/k/v/o, optimizer
+#    state for adapters only. --export-merged also writes the folded
+#    base+adapter weights for the generation CLI.
+python -m hyperion_tpu.cli.main \
+  --model llama --llama_size "$SIZE" --lora --epochs 2 \
+  --base_dir "$WORK" --export-merged
+
+# 2. A tokenizer for sampling: the quick path trains a small ByteBPE on
+#    a few lines (replace with your corpus; skipped if one exists).
+if [ ! -f "$WORK/tokenizer/vocab.json" ]; then
+  python - "$WORK" <<'EOF'
+import sys
+from hyperion_tpu.data.bpe import train_bpe
+tok = train_bpe(["the quick brown fox jumps over the lazy dog"] * 8,
+                vocab_size=256, verbose=False)  # <= tiny llama vocab
+tok.save(sys.argv[1] + "/tokenizer")
+EOF
+fi
+
+# 3. Generate from the merged checkpoint — float, then weight-only int8
+#    (same weights, int8 MXU matmuls, half the weight HBM traffic).
+CKPT="$WORK/checkpoints/llama_lora_bf16_merged.npz"
+python -m hyperion_tpu.infer \
+  --prompt "the quick" --max-new-tokens 16 --max-len 64 \
+  --ckpt "$CKPT" --tokenizer-dir "$WORK/tokenizer"
+python -m hyperion_tpu.infer \
+  --prompt "the quick" --max-new-tokens 16 --max-len 64 \
+  --ckpt "$CKPT" --tokenizer-dir "$WORK/tokenizer" --quant int8

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Train the toy LM on your own text, then sample from it.
+#
+#   examples/train_and_generate.sh [workdir] [raw.txt]
+#
+# With no raw text file the data pipeline falls back to the
+# deterministic synthetic corpus — the script still runs end to end.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+WORK="${1:-data/example_lm}"
+RAW="${2:-}"
+
+# 1. Tokenizer + corpus prep (in-tree byte-level BPE -> native recordio).
+#    Skipped when no raw text is given; training then uses the synthetic
+#    fallback corpus with the GPT-2-sized vocab.
+if [ -n "$RAW" ]; then
+  python -m hyperion_tpu.data.prepare \
+    --input "$RAW" --split-name train --base-dir "$WORK" --vocab-size 8192
+fi
+
+# 2. Train: DDP over every local chip (one process, mesh under the hood),
+#    per-epoch validation, CSV metrics, orbax checkpoints + .npz export.
+python -m hyperion_tpu.cli.main \
+  --model language_ddp --epochs 3 --base_dir "$WORK"
+
+# 3. Generate from the exported checkpoint. The tokenizer dir only
+#    exists if step 1 ran; otherwise point --tokenizer-dir at any
+#    trained ByteBPE directory.
+if [ -d "$WORK/tokenizer" ]; then
+  python -m hyperion_tpu.infer \
+    --prompt "The quick" --max-new-tokens 32 \
+    --ckpt "$WORK/checkpoints/language_ddp_final.npz" \
+    --tokenizer-dir "$WORK/tokenizer"
+else
+  echo "(no tokenizer trained — pass a raw text file to sample text)"
+fi

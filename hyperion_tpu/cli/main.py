@@ -41,6 +41,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="global batch (defaults per job: LM 32, CIFAR 64, llama 8)")
     p.add_argument("--lora", action="store_true",
                    help="llama: LoRA adapters instead of FSDP full fine-tune")
+    p.add_argument("--export-merged", action="store_true",
+                   help="LoRA runs: also export base+adapters merged so "
+                        "infer.generate can load the fine-tune directly")
     p.add_argument("--llama_size", choices=["tiny", "7b"], default="7b")
     p.add_argument("--steps-per-epoch", type=int, default=0,
                    help="cap steps per epoch (0 = full pass)")
@@ -120,6 +123,7 @@ def make_config(args, job: str) -> Config:
     cfg.train.profile_dir = args.profile_dir
     cfg.train.seed = args.seed
     cfg.train.lora = args.lora
+    cfg.train.export_merged = args.export_merged
     cfg.train.model = "llama_tiny" if args.llama_size == "tiny" else "llama_7b"
     cfg.optimization.precision = args.precision
     cfg.optimization.grad_accum_steps = args.grad_accum

@@ -94,6 +94,11 @@ class TrainConfig:
     lora_rank: int = 16              # reference LoraConfig r=16 α=32 (:470)
     lora_alpha: float = 32.0
     lora_dropout: float = 0.05
+    # also export base+adapters merged (models/lora.py:merge_lora) next
+    # to the adapters-only npz, so the generation CLI can load a LoRA
+    # fine-tune directly. Off by default: gathering a 7B base to host
+    # doubles export time/disk for runs that only need adapters.
+    export_merged: bool = False
     moe_experts: int = 0             # >0: language jobs use the MoE LM
     moe_top_k: int = 2
     moe_every: int = 2               # every k-th block is sparse

@@ -256,6 +256,13 @@ def main(argv=None) -> int:
 
         model, params = quantize_llama(params, model.cfg)
     decode = generate if cached else generate_recompute
+    if tok.vocab_size > model.cfg.vocab_size:
+        print(
+            f"[generate] warning: tokenizer vocab {tok.vocab_size} exceeds "
+            f"model vocab {model.cfg.vocab_size} — prompt ids above the "
+            "model's range would be silently clamped by the embedding "
+            "lookup; retrain the tokenizer at or below the model vocab"
+        )
     ids = jnp.asarray([tok.encode(args.prompt)], jnp.int32)
     out = decode(
         model, {"params": params}, ids, args.max_new_tokens,

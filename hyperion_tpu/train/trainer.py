@@ -40,6 +40,7 @@ from hyperion_tpu.models.lora import (
     LoraConfig,
     apply_lora,
     init_lora_params,
+    merge_lora,
     trainable_fraction,
 )
 from hyperion_tpu.models.resnet import resnet18
@@ -819,4 +820,12 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
     ckpt.export_gathered(
         f"{cfg.train.base_dir}/checkpoints/{job}_{mode}_final.npz", export
     )
+    if cfg.train.lora and cfg.train.export_merged:
+        # base+adapters folded into plain Llama params: what the
+        # generation CLI loads. Opt-in (--export-merged): gathering the
+        # base doubles export cost, which 7B capture runs don't want.
+        ckpt.export_gathered(
+            f"{cfg.train.base_dir}/checkpoints/{job}_{mode}_merged.npz",
+            merge_lora(state.params["base"], state.params["lora"], lora_cfg),
+        )
     return TrainResult(job, logger.run, str(logger.path), ckpt_dir, history)
