@@ -241,20 +241,17 @@ def main(argv=None) -> int:
                         "weight table to infer it from)")
     p.add_argument("--quant", choices=["none", "int8"], default="none",
                    help="int8 = weight-only quantized decode "
-                        "(Llama exports only; precision/quant.py)")
+                        "(precision/quant.py)")
     args = p.parse_args(argv)
 
     tok = ByteBPE.load(args.tokenizer_dir)
     params = load_gathered(args.ckpt)
     model, cached = model_from_npz(params, args.max_len)
     if args.quant == "int8":
-        if not cached:
-            raise SystemExit(
-                "--quant int8 currently supports Llama exports only"
-            )
-        from hyperion_tpu.precision.quant import quantize_llama
+        from hyperion_tpu.precision.quant import quantize_llama, quantize_lm
 
-        model, params = quantize_llama(params, model.cfg)
+        quantize = quantize_llama if cached else quantize_lm
+        model, params = quantize(params, model.cfg)
     decode = generate if cached else generate_recompute
     if tok.vocab_size > model.cfg.vocab_size:
         print(

@@ -91,20 +91,16 @@ def llama_tiny_config(**kw) -> LlamaConfig:
 
 
 def _dense_ctor(c: LlamaConfig):
-    """The dense-layer constructor for this config: float
-    `nn.DenseGeneral` normally, `QuantDenseGeneral` (weight-only int8)
-    when `c.quant == "int8"`. `nn.DenseGeneral(features=int, axis=-1)`
-    is exactly `nn.Dense` (same `kernel` leaf name and shape), so
-    checkpoints are unaffected by routing everything through one ctor."""
-    if c.quant == "int8":
-        from hyperion_tpu.precision.quant import QuantDenseGeneral
+    """Llama's dense layers: bias-free, normal(0.02) init, routed
+    through the shared quant dispatch (`precision.quant.make_dense`) so
+    `c.quant == "int8"` swaps in `QuantDenseGeneral` everywhere.
+    `nn.DenseGeneral(features=int, axis=-1)` is exactly `nn.Dense`
+    (same `kernel` leaf name and shape), so checkpoints are unaffected
+    by routing everything through one ctor."""
+    from hyperion_tpu.precision.quant import make_dense
 
-        return partial(QuantDenseGeneral, dtype=c.compute_dtype)
-    if c.quant != "none":
-        raise ValueError(f"unknown quant mode {c.quant!r}")
-    return partial(
-        nn.DenseGeneral, use_bias=False, dtype=c.compute_dtype,
-        kernel_init=nn.initializers.normal(0.02),
+    return make_dense(
+        c, kernel_init=nn.initializers.normal(0.02), use_bias=False,
     )
 
 

@@ -51,6 +51,10 @@ class TransformerLMConfig:
     # from precision.remat.REMAT_POLICIES ("dots", "dots_no_batch")
     remat: bool | str = False
     dtype: str = "float32"         # compute dtype; params stay fp32
+    # "none" | "int8": weight-only int8 inference — dense kernels become
+    # int8+scale (precision/quant.py, converted by quantize_lm); biases,
+    # norms and embeddings stay float. Inference-only.
+    quant: str = "none"
 
     @property
     def remat_policy(self) -> str:
@@ -78,6 +82,19 @@ def gpt2_lm_config(**kw) -> TransformerLMConfig:
     return TransformerLMConfig(**base)
 
 
+def _dense_ctor(c, kernel_init):
+    """This family's dense layers: biased (the GPT-2 shape), each site
+    keeping its original `kernel_init`, routed through the shared quant
+    dispatch (`precision.quant.make_dense`) so `c.quant == "int8"`
+    swaps in `QuantDenseGeneral` (bias stays float) everywhere.
+    `nn.DenseGeneral(features=int, axis=-1)` is exactly `nn.Dense`
+    (same param leaves), so float checkpoints and training dynamics are
+    unaffected by the shared ctor."""
+    from hyperion_tpu.precision.quant import make_dense
+
+    return make_dense(c, kernel_init=kernel_init, use_bias=True)
+
+
 class MHA(nn.Module):
     cfg: TransformerLMConfig
 
@@ -86,10 +103,8 @@ class MHA(nn.Module):
         c = self.cfg
         B, T, _ = x.shape
         dense = partial(
-            nn.DenseGeneral,
+            _dense_ctor(c, nn.initializers.xavier_uniform()),
             features=(c.n_heads, c.head_dim),
-            dtype=c.compute_dtype,
-            kernel_init=nn.initializers.xavier_uniform(),
         )
         q = dense(name="q_proj")(x)
         k = dense(name="k_proj")(x)
@@ -97,11 +112,9 @@ class MHA(nn.Module):
         out = dot_product_attention(
             q, k, v, causal=c.causal, padding_mask=padding_mask, impl=c.attention_impl
         )
-        return nn.DenseGeneral(
+        return _dense_ctor(c, nn.initializers.xavier_uniform())(
             features=c.d_model,
             axis=(-2, -1),
-            dtype=c.compute_dtype,
-            kernel_init=nn.initializers.xavier_uniform(),
             name="o_proj",
         )(out)
 
@@ -140,9 +153,10 @@ class Block(nn.Module):
         h = nn.Dropout(c.dropout, deterministic=deterministic)(h)
         x = x + h
         h = _norm(c, "ln2")(x)
-        h = nn.Dense(c.ff_dim, dtype=c.compute_dtype, name="fc1")(h)
+        mlp_init = nn.initializers.lecun_normal()  # the nn.Dense default
+        h = _dense_ctor(c, mlp_init)(features=c.ff_dim, name="fc1")(h)
         h = act(h)
-        h = nn.Dense(c.d_model, dtype=c.compute_dtype, name="fc2")(h)
+        h = _dense_ctor(c, mlp_init)(features=c.d_model, name="fc2")(h)
         h = nn.Dropout(c.dropout, deterministic=deterministic)(h)
         return x + h
 
@@ -193,10 +207,8 @@ def lm_backbone(c: TransformerLMConfig, input_ids, padding_mask,
     for i in range(c.n_layers):
         x = make_block(i)(x, padding_mask, deterministic)
     x = _norm(c, "ln_f")(x)
-    logits = nn.Dense(
-        c.vocab_size,
-        dtype=c.compute_dtype,
-        kernel_init=nn.initializers.normal(0.02),
+    logits = _dense_ctor(c, nn.initializers.normal(0.02))(
+        features=c.vocab_size,
         name="lm_head",
     )(x)
     return logits.astype(jnp.float32)

@@ -4,8 +4,11 @@ from hyperion_tpu.precision.quant import (  # noqa: F401
     dequantize,
     dequantize_params,
     int8_matmul,
+    make_dense,
+    quantize_for,
     quantize_int8,
     quantize_llama,
+    quantize_lm,
     quantize_params_like,
     quantized_dense,
 )

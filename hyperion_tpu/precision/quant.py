@@ -119,12 +119,10 @@ class QuantDenseGeneral(nn.Module):
     features: int | tuple[int, ...]
     axis: int | tuple[int, ...] = -1
     dtype: jnp.dtype | str = jnp.bfloat16
-    use_bias: bool = False  # signature parity; bias unsupported
+    use_bias: bool = False  # bias stays float and adds after dequant (exact)
 
     @nn.compact
     def __call__(self, x):
-        if self.use_bias:
-            raise NotImplementedError("QuantDenseGeneral is bias-free")
         feats = (self.features,) if isinstance(self.features, int) \
             else tuple(self.features)
         axes = (self.axis,) if isinstance(self.axis, int) else tuple(self.axis)
@@ -147,7 +145,11 @@ class QuantDenseGeneral(nn.Module):
             ks.reshape(1, out_dim),
             out_dtype=self.dtype,
         )
-        return out.reshape(*lead, *feats)
+        out = out.reshape(*lead, *feats)
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros, feats, jnp.float32)
+            out = out + b.astype(out.dtype)
+        return out
 
 
 def quantize_params_like(params, quant_shapes):
@@ -192,6 +194,38 @@ def quantize_params_like(params, quant_shapes):
     return walk(params, quant_shapes)
 
 
+def make_dense(cfg, *, kernel_init, use_bias=False):
+    """Shared quant dispatch for model dense layers: the float
+    `nn.DenseGeneral` (with the site's own `kernel_init`) normally,
+    `QuantDenseGeneral` when `cfg.quant == "int8"`. Both Llama and
+    TransformerLM route every dense through this one helper so a new
+    quant mode lands in one place. Configs without a `quant` field
+    (MoELM shares the LM scaffold) stay on the float path."""
+    import functools
+
+    mode = getattr(cfg, "quant", "none")
+    if mode == "int8":
+        return functools.partial(
+            QuantDenseGeneral, dtype=cfg.compute_dtype, use_bias=use_bias,
+        )
+    if mode != "none":
+        raise ValueError(f"unknown quant mode {mode!r}")
+    return functools.partial(
+        nn.DenseGeneral, dtype=cfg.compute_dtype,
+        kernel_init=kernel_init, use_bias=use_bias,
+    )
+
+
+def quantize_for(qmodel, params, init=None):
+    """Weight-only int8 against ANY quant-twin model: derive the target
+    layout from `qmodel`'s own init shapes (`jax.eval_shape` — no
+    memory) and convert `params` into it. `init(qmodel, rng)` defaults
+    to `qmodel.init_params(rng)`."""
+    init = init or (lambda m, r: m.init_params(r))
+    shapes = jax.eval_shape(lambda r: init(qmodel, r), jax.random.key(0))
+    return quantize_params_like(params, shapes)
+
+
 def quantize_llama(params, cfg):
     """Weight-only int8 for a Llama checkpoint: returns
     `(quant_model, quant_params)` ready for `infer.generate`.
@@ -205,11 +239,21 @@ def quantize_llama(params, cfg):
     from hyperion_tpu.models.llama import Llama  # lazy: avoid a cycle
 
     qmodel = Llama(dataclasses.replace(cfg, quant="int8"))
-    shapes = jax.eval_shape(
-        lambda r: qmodel.init_params(r, batch=1, seq=min(8, cfg.max_len)),
-        jax.random.key(0),
+    return qmodel, quantize_for(
+        qmodel, params,
+        init=lambda m, r: m.init_params(r, batch=1, seq=min(8, cfg.max_len)),
     )
-    return qmodel, quantize_params_like(params, shapes)
+
+
+def quantize_lm(params, cfg):
+    """Weight-only int8 for a TransformerLM checkpoint (the recompute
+    generation path) — same contract as `quantize_llama`."""
+    import dataclasses
+
+    from hyperion_tpu.models.transformer_lm import TransformerLM  # lazy
+
+    qmodel = TransformerLM(dataclasses.replace(cfg, quant="int8"))
+    return qmodel, quantize_for(qmodel, params)
 
 
 def dequantize_params(qparams, dtype: jnp.dtype | str = jnp.bfloat16):
