@@ -1,4 +1,4 @@
-"""Autoregressive generation — greedy / temperature / top-k sampling.
+"""Autoregressive generation — greedy / temperature / top-k / top-p.
 
 Beyond reference parity: the MI250X project trains models but never
 samples from them (no generation code anywhere — SURVEY §2). Here a
@@ -36,8 +36,11 @@ import numpy as np
 
 
 def sample_token(logits: jax.Array, rng: jax.Array | None,
-                 temperature: float = 0.0, top_k: int = 0) -> jax.Array:
-    """logits [B, V] → token ids [B]. temperature 0 = greedy."""
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0) -> jax.Array:
+    """logits [B, V] → token ids [B]. temperature 0 = greedy; top_k and
+    top_p (nucleus) restrict the support and compose (k first, then p),
+    both applied after the temperature rescale."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if rng is None:
@@ -46,6 +49,22 @@ def sample_token(logits: jax.Array, rng: jax.Array | None,
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        if top_p <= 0.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # whose mass reaches top_p (the first token always survives:
+        # its preceding cumulative mass is 0 < top_p)
+        order = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        mass_before = jnp.cumsum(probs, axis=-1) - probs
+        kept = jnp.where(mass_before < top_p, sorted_logits, -jnp.inf)
+        # scatter back through the permutation already in hand (a second
+        # argsort would re-sort the full vocab every decode tick)
+        logits = jnp.full_like(logits, -jnp.inf).at[
+            jnp.arange(logits.shape[0])[:, None], order
+        ].set(kept)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -70,6 +89,7 @@ def generate(
     pad_id: int = 0,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     rng: jax.Array | None = None,
 ) -> jax.Array:
     """KV-cache decoding → generated ids [B, max_new_tokens].
@@ -93,7 +113,7 @@ def generate(
         variables, prompt_ids, cache=cache, cache_index=0
     )
     rngs = _step_rngs(rng, max_new_tokens, temperature)
-    first = sample_token(logits[:, -1], rngs[0], temperature, top_k)
+    first = sample_token(logits[:, -1], rngs[0], temperature, top_k, top_p)
     done = jnp.zeros((B,), bool) if eos_id is None else first == eos_id
 
     def tick(carry, rng_t):
@@ -101,7 +121,7 @@ def generate(
         logits, cache = model.apply(
             variables, tok[:, None], cache=cache, cache_index=idx
         )
-        nxt = sample_token(logits[:, 0], rng_t, temperature, top_k)
+        nxt = sample_token(logits[:, 0], rng_t, temperature, top_k, top_p)
         nxt = jnp.where(done, pad_id, nxt)
         if eos_id is not None:
             done = done | (nxt == eos_id)
@@ -125,6 +145,7 @@ def generate_recompute(
     pad_id: int = 0,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     rng: jax.Array | None = None,
 ) -> jax.Array:
     """Cache-free decoding for any causal LM (same contract as
@@ -146,7 +167,7 @@ def generate_recompute(
         out = model.apply(variables, buf)
         logits = out[0] if isinstance(out, tuple) else out  # MoE aux path
         last = jax.vmap(lambda row, i: row[i])(logits, idx - 1)  # [B, V]
-        nxt = sample_token(last, rng_t, temperature, top_k)
+        nxt = sample_token(last, rng_t, temperature, top_k, top_p)
         nxt = jnp.where(done, pad_id, nxt)
         if eos_id is not None:
             done = done | (nxt == eos_id)
@@ -235,6 +256,9 @@ def main(argv=None) -> int:
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=1.0,
+                   help="nucleus sampling: keep the smallest prefix of "
+                        "the distribution reaching this mass (1.0 = off)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--max-len", type=int, default=4096,
                    help="context length for Llama exports (RoPE has no "
@@ -264,7 +288,7 @@ def main(argv=None) -> int:
     out = decode(
         model, {"params": params}, ids, args.max_new_tokens,
         eos_id=tok.eos_id, pad_id=tok.eos_id,  # pads vanish in decode
-        temperature=args.temperature, top_k=args.top_k,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         rng=jax.random.key(args.seed),
     )
     text = tok.decode([t for t in np.asarray(out[0]) if t != tok.eos_id])

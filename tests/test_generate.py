@@ -146,6 +146,29 @@ class TestSampleToken:
             )
             assert int(t[0]) in (0, 1)
 
+    def test_top_p_restricts_support(self):
+        # token 0 holds ~95% of the mass: any top_p <= 0.95 keeps only it
+        logits = jnp.asarray([[5.0, 2.0, 1.0, 0.0]])
+        for seed in range(8):
+            t = sample_token(
+                logits, jax.random.key(seed), temperature=1.0, top_p=0.5
+            )
+            assert int(t[0]) == 0
+        # p=1.0 is a no-op: every token stays reachable
+        seen = {
+            int(sample_token(jnp.zeros((1, 4)), jax.random.key(s),
+                             temperature=1.0, top_p=1.0)[0])
+            for s in range(32)
+        }
+        assert seen == {0, 1, 2, 3}
+
+    def test_top_p_first_token_always_survives(self):
+        # a peaked distribution with tiny top_p must not mask everything
+        logits = jnp.asarray([[0.0, 0.0, 0.0, 0.0]])
+        t = sample_token(logits, jax.random.key(0), temperature=1.0,
+                         top_p=1e-6)
+        assert 0 <= int(t[0]) < 4
+
 
 class TestGenerationCLI:
     @pytest.mark.slow
