@@ -277,6 +277,18 @@ def main(argv=None) -> int:
         quantize = quantize_llama if cached else quantize_lm
         model, params = quantize(params, model.cfg)
     decode = generate if cached else generate_recompute
+    # one jit around the WHOLE generation: prefill + the token scan
+    # compile into a single XLA program, so the CLI pays one dispatch
+    # instead of one per op — the difference between interactive and
+    # painful over a remote-tunnel backend
+    decode = jax.jit(
+        lambda variables, ids, rng, _d=decode: _d(
+            model, variables, ids, args.max_new_tokens,
+            eos_id=tok.eos_id, pad_id=tok.eos_id,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, rng=rng,
+        )
+    )
     if tok.vocab_size > model.cfg.vocab_size:
         print(
             f"[generate] warning: tokenizer vocab {tok.vocab_size} exceeds "
@@ -285,12 +297,7 @@ def main(argv=None) -> int:
             "lookup; retrain the tokenizer at or below the model vocab"
         )
     ids = jnp.asarray([tok.encode(args.prompt)], jnp.int32)
-    out = decode(
-        model, {"params": params}, ids, args.max_new_tokens,
-        eos_id=tok.eos_id, pad_id=tok.eos_id,  # pads vanish in decode
-        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-        rng=jax.random.key(args.seed),
-    )
+    out = decode({"params": params}, ids, jax.random.key(args.seed))
     text = tok.decode([t for t in np.asarray(out[0]) if t != tok.eos_id])
     print(args.prompt + text)
     return 0

@@ -147,7 +147,8 @@ class TestSampleToken:
             assert int(t[0]) in (0, 1)
 
     def test_top_p_restricts_support(self):
-        # token 0 holds ~95% of the mass: any top_p <= 0.95 keeps only it
+        # token 0 holds ~93% of the mass (softmax([5,2,1,0])): any
+        # top_p <= 0.93 keeps only it
         logits = jnp.asarray([[5.0, 2.0, 1.0, 0.0]])
         for seed in range(8):
             t = sample_token(
