@@ -1,7 +1,9 @@
-"""Inference: autoregressive generation with KV-cache decoding."""
+"""Inference: autoregressive generation with KV-cache decoding,
+weight-only int8, and speculative decoding."""
 
 from hyperion_tpu.infer.generate import (  # noqa: F401
     generate,
     generate_recompute,
     sample_token,
 )
+from hyperion_tpu.infer.speculative import generate_speculative  # noqa: F401

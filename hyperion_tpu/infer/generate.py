@@ -266,6 +266,12 @@ def main(argv=None) -> int:
     p.add_argument("--quant", choices=["none", "int8"], default="none",
                    help="int8 = weight-only quantized decode "
                         "(precision/quant.py)")
+    p.add_argument("--draft-ckpt", default=None,
+                   help="speculative decoding: a smaller Llama export "
+                        "whose proposals the main model verifies (greedy "
+                        "only; same vocab; infer/speculative.py)")
+    p.add_argument("--draft-k", type=int, default=4,
+                   help="speculative proposals per verify round")
     args = p.parse_args(argv)
 
     tok = ByteBPE.load(args.tokenizer_dir)
@@ -276,19 +282,57 @@ def main(argv=None) -> int:
 
         quantize = quantize_llama if cached else quantize_lm
         model, params = quantize(params, model.cfg)
-    decode = generate if cached else generate_recompute
-    # one jit around the WHOLE generation: prefill + the token scan
-    # compile into a single XLA program, so the CLI pays one dispatch
-    # instead of one per op — the difference between interactive and
-    # painful over a remote-tunnel backend
-    decode = jax.jit(
-        lambda variables, ids, rng, _d=decode: _d(
-            model, variables, ids, args.max_new_tokens,
-            eos_id=tok.eos_id, pad_id=tok.eos_id,
-            temperature=args.temperature, top_k=args.top_k,
-            top_p=args.top_p, rng=rng,
+    if args.draft_ckpt:
+        if not cached:
+            raise SystemExit("--draft-ckpt needs a Llama (KV-cache) target")
+        if args.temperature > 0:
+            raise SystemExit(
+                "speculative decoding is greedy-only; drop --temperature"
+            )
+        from hyperion_tpu.infer.speculative import generate_speculative
+
+        draft_params = load_gathered(args.draft_ckpt)
+        draft_model, draft_cached = model_from_npz(draft_params, args.max_len)
+        if not draft_cached:
+            raise SystemExit("--draft-ckpt must be a Llama export")
+        if args.quant == "int8":
+            from hyperion_tpu.precision.quant import quantize_llama
+
+            draft_model, draft_params = quantize_llama(
+                draft_params, draft_model.cfg
+            )
+        if args.draft_k < 1:
+            raise SystemExit("--draft-k must be >= 1")
+        n_prompt = len(tok.encode(args.prompt))
+        if n_prompt <= args.draft_k:
+            raise SystemExit(
+                f"prompt encodes to {n_prompt} tokens but speculative "
+                f"decoding needs more than --draft-k={args.draft_k} — "
+                "use a longer prompt or a smaller k"
+            )
+    # one jit around the WHOLE generation: prefill + the token scan (or
+    # the full speculative while-loop) compile into a single XLA
+    # program, so the CLI pays one dispatch instead of one per op — the
+    # difference between interactive and painful over a remote-tunnel
+    # backend
+    if args.draft_ckpt:
+        decode = jax.jit(
+            lambda variables, ids, rng: generate_speculative(
+                model, variables, draft_model, {"params": draft_params},
+                ids, args.max_new_tokens, k=args.draft_k,
+                eos_id=tok.eos_id, pad_id=tok.eos_id,
+            )
         )
-    )
+    else:
+        _d = generate if cached else generate_recompute
+        decode = jax.jit(
+            lambda variables, ids, rng: _d(
+                model, variables, ids, args.max_new_tokens,
+                eos_id=tok.eos_id, pad_id=tok.eos_id,
+                temperature=args.temperature, top_k=args.top_k,
+                top_p=args.top_p, rng=rng,
+            )
+        )
     if tok.vocab_size > model.cfg.vocab_size:
         print(
             f"[generate] warning: tokenizer vocab {tok.vocab_size} exceeds "

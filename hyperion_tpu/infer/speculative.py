@@ -1,0 +1,180 @@
+"""Speculative decoding — draft proposes, target verifies in one pass.
+
+Beyond reference parity (the MI250X project never samples at all —
+SURVEY §2) and beyond this framework's own KV-cache decode: a small
+draft model proposes `k` tokens autoregressively, then the target model
+scores all of them in ONE forward. Greedy acceptance keeps the longest
+proposal prefix the target agrees with, plus the target's own next
+token — so each round emits between 1 and k+1 tokens for a single
+target forward, and the output is TOKEN-FOR-TOKEN IDENTICAL to plain
+greedy decoding with the target alone (the acceptance rule only ever
+keeps tokens the target's argmax would have produced; the tests assert
+this equality).
+
+TPU shape: the whole loop is one `lax.while_loop` inside one jit —
+static shapes everywhere (fixed k+1 verify window, fixed draft
+windows), no host round-trips between rounds. KV caches are never
+"rolled back": both models mask attention by position, so entries past
+the accepted index are invisible-stale and simply overwritten by later
+rounds. The draft additionally re-feeds a fixed (k+1)-token window each
+round, which plugs the one cache gap full acceptance would leave
+(recomputing an existing entry writes identical K/V, so the rewrite is
+idempotent).
+
+Scope: batch 1 (a latency optimization; per-row acceptance counts would
+need per-row cache indices, which the static cache API keeps scalar)
+and greedy (temperature 0) — the regime where the equality guarantee
+is exact. Prompt must be longer than `k` tokens (the draft's re-feed
+window reaches k positions back).
+
+Reference for the technique: Leviathan et al. 2023 / Chen et al. 2023
+(public); implementation is original to this repo.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from hyperion_tpu.infer.generate import sample_token
+
+
+def _argmax_tok(logits: jax.Array) -> jax.Array:
+    # greedy = sample_token's temperature-0 path, shared so the
+    # token-for-token equality promise tracks one implementation
+    return sample_token(logits, None)
+
+
+def generate_speculative(
+    model: Any,
+    variables: dict,
+    draft_model: Any,
+    draft_variables: dict,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    *,
+    k: int = 4,
+    eos_id: int | None = None,
+    pad_id: int = 0,
+) -> jax.Array:
+    """Greedy speculative decode → ids [1, max_new_tokens], identical
+    to `generate(model, ...)` at temperature 0.
+
+    Both models must share a vocabulary and support the KV-cache call
+    signature (`cache`/`cache_index` — Llama here). `k` is the number
+    of draft proposals per round; each round costs one draft window
+    pass + (k-1) draft steps + ONE target pass over k+1 tokens.
+    """
+    # lazy model import: keep `import hyperion_tpu.infer` light
+    # (generate.py follows the same pattern)
+    from hyperion_tpu.models.llama import init_cache
+
+    B, P = prompt_ids.shape
+    if B != 1:
+        raise ValueError(f"speculative decode is batch-1 (got batch {B})")
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if P <= k:
+        raise ValueError(
+            f"prompt length {P} must exceed k={k} (the draft re-feed "
+            "window reaches k positions back)"
+        )
+    cfg_t, cfg_d = model.cfg, draft_model.cfg
+    if cfg_t.vocab_size != cfg_d.vocab_size:
+        raise ValueError(
+            f"vocab mismatch: target {cfg_t.vocab_size} vs draft "
+            f"{cfg_d.vocab_size}"
+        )
+    # seq buffer holds prompt + generated (+ one round of overshoot)
+    L = P + max_new_tokens + k + 1
+    if L > min(cfg_t.max_len, cfg_d.max_len):
+        raise ValueError(
+            f"prompt {P} + {max_new_tokens} new tokens (+{k + 1} "
+            f"speculation slack) exceeds max_len "
+            f"{min(cfg_t.max_len, cfg_d.max_len)}"
+        )
+
+    t_cache = init_cache(cfg_t, 1, max_len=L)
+    d_cache = init_cache(cfg_d, 1, max_len=L)
+    # prefill both models; the first generated token comes from the
+    # target (position P), exactly as in plain `generate`
+    t_logits, t_cache = model.apply(
+        variables, prompt_ids, cache=t_cache, cache_index=0
+    )
+    _, d_cache = draft_model.apply(
+        draft_variables, prompt_ids, cache=d_cache, cache_index=0
+    )
+    tok0 = _argmax_tok(t_logits[:, -1])  # [1]
+
+    seq = jnp.zeros((1, L), jnp.int32)
+    seq = jax.lax.dynamic_update_slice(seq, prompt_ids.astype(jnp.int32), (0, 0))
+    seq = seq.at[0, P].set(tok0[0])
+
+    def round_(carry):
+        seq, t_cache, d_cache, idx, n_gen = carry
+        # ---- draft: re-feed the (k+1)-window ending at idx, then
+        # propose k tokens with k-1 single steps. The window rewrite
+        # repairs any entries a full-acceptance round left unwritten.
+        window = jax.lax.dynamic_slice(seq, (0, idx - k), (1, k + 1))
+        d_logits, d_cache = draft_model.apply(
+            draft_variables, window, cache=d_cache, cache_index=idx - k
+        )
+        d1 = _argmax_tok(d_logits[:, -1])  # proposal for position idx+1
+
+        def d_step(carry, i):
+            d_cache, tok = carry
+            logits, d_cache = draft_model.apply(
+                draft_variables, tok[:, None], cache=d_cache,
+                cache_index=idx + 1 + i,
+            )
+            nxt = _argmax_tok(logits[:, 0])
+            return (d_cache, nxt), tok
+
+        (d_cache, d_last), d_prev = jax.lax.scan(
+            d_step, (d_cache, d1), jnp.arange(k - 1)
+        )
+        # d_arr[i] = proposal for position idx+1+i, i = 0..k-1
+        d_arr = jnp.concatenate([d_prev.reshape(-1), d_last.reshape(-1)]) \
+            if k > 1 else d1.reshape(-1)
+
+        # ---- target: ONE pass over [tok, d_1..d_k] scores every
+        # proposal; row i predicts position idx+1+i
+        verify = jnp.concatenate(
+            [jax.lax.dynamic_slice(seq, (0, idx), (1, 1)), d_arr[None, :]],
+            axis=1,
+        )
+        t_logits, t_cache = model.apply(
+            variables, verify, cache=t_cache, cache_index=idx
+        )
+        t_arr = _argmax_tok(t_logits[0])  # [k+1]
+
+        # ---- greedy acceptance: longest agreeing prefix + the
+        # target's own token at the first disagreement (or the bonus
+        # token after full acceptance)
+        matches = d_arr == t_arr[:k]
+        m = jnp.where(matches.all(), k, jnp.argmin(matches)).astype(jnp.int32)
+        # v[i] decided for i <= m: proposals below m (== target tokens),
+        # the target's correction/bonus at m; junk above m is
+        # overwritten by later rounds before anything reads it
+        d_ext = jnp.concatenate([d_arr, jnp.zeros((1,), jnp.int32)])
+        v = jnp.where(jnp.arange(k + 1) == m, t_arr, d_ext)
+        seq = jax.lax.dynamic_update_slice(seq, v[None, :], (0, idx + 1))
+        return seq, t_cache, d_cache, idx + m + 1, n_gen + m + 1
+
+    def cond(carry):
+        *_, n_gen = carry
+        return n_gen < max_new_tokens
+
+    seq, *_ = jax.lax.while_loop(
+        cond, round_, (seq, t_cache, d_cache, jnp.int32(P), jnp.int32(1))
+    )
+    out = jax.lax.dynamic_slice(seq, (0, P), (1, max_new_tokens))
+    if eos_id is not None:
+        # same contract as `generate`: positions after the first eos
+        # become pad (the eos itself stays)
+        hit = jnp.cumsum((out == eos_id).astype(jnp.int32), axis=1)
+        after_eos = (hit - (out == eos_id)) > 0
+        out = jnp.where(after_eos, pad_id, out)
+    return out

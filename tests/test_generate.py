@@ -197,6 +197,33 @@ class TestGenerationCLI:
         assert rc == 0
 
     @pytest.mark.slow
+    def test_main_speculative(self, tmp_path):
+        """Target + draft Llama exports -> --draft-ckpt CLI decode."""
+        from hyperion_tpu.checkpoint.io import export_gathered
+        from hyperion_tpu.data.bpe import train_bpe
+        from hyperion_tpu.infer.generate import main
+        from hyperion_tpu.models.llama import Llama, llama_tiny_config
+
+        tok = train_bpe(["the quick brown fox jumps over the lazy dog"] * 4,
+                        vocab_size=256, verbose=False)
+        tok.save(tmp_path / "tok")
+        cfg = llama_tiny_config(vocab_size=tok.vocab_size, max_len=64)
+        export_gathered(tmp_path / "target.npz",
+                        Llama(cfg).init_params(jax.random.key(0), seq=8))
+        dcfg = llama_tiny_config(vocab_size=tok.vocab_size, max_len=64,
+                                 n_layers=1)
+        export_gathered(tmp_path / "draft.npz",
+                        Llama(dcfg).init_params(jax.random.key(1), seq=8))
+        rc = main([
+            "--prompt", "the quick brown fox jumps",
+            "--ckpt", str(tmp_path / "target.npz"),
+            "--draft-ckpt", str(tmp_path / "draft.npz"), "--draft-k", "3",
+            "--tokenizer-dir", str(tmp_path / "tok"),
+            "--max-new-tokens", "6", "--max-len", "64",
+        ])
+        assert rc == 0
+
+    @pytest.mark.slow
     def test_main_quant_int8_llama(self, tmp_path):
         """Llama export -> --quant int8 weight-only decode via the CLI."""
         from hyperion_tpu.checkpoint.io import export_gathered
