@@ -38,15 +38,20 @@ MODEL_SPECS = {
 }
 
 
-def benchmark_decode(
-    name: str, batch: int = 8, prompt_len: int = 128, decode_len: int = 64,
-    quant: str = "none",
-) -> dict:
+def _init_model(name: str):
     cfg = llama_tiny_config(**MODEL_SPECS[name])  # tiny base + overrides
     model = Llama(cfg)
     params = jax.jit(
         lambda r: model.init_params(r, seq=min(8, cfg.max_len))
     )(jax.random.key(0))
+    return cfg, model, params
+
+
+def benchmark_decode(
+    name: str, batch: int = 8, prompt_len: int = 128, decode_len: int = 64,
+    quant: str = "none",
+) -> dict:
+    cfg, model, params = _init_model(name)
     if quant == "int8":
         # weight-only int8 (precision/quant.py): kernels become int8 +
         # per-channel scales — half bf16's weight HBM traffic, which is
@@ -109,6 +114,7 @@ def benchmark_decode(
     decode_live_mb = live_bytes_in_use() / 1e6
     return {
         "model": name,
+        "mode": "chain",  # dispatch-free chained slope (see module doc)
         "quant": quant,
         "batch": batch,
         "prompt_len": prompt_len,
@@ -124,6 +130,50 @@ def benchmark_decode(
     }
 
 
+def benchmark_speculative(
+    name: str, prompt_len: int = 128, decode_len: int = 64, k: int = 4,
+) -> list[dict]:
+    """Batch-1 whole-generation wall time: plain greedy vs speculative
+    with the target as its own draft (total acceptance). The pair bounds
+    the speculation machinery: `spec_ceiling` is the best case (every
+    round emits k+1 tokens for one target pass, including all scheme
+    overheads — draft passes, verify window, acceptance bookkeeping);
+    real drafts land between the two rows depending on agreement rate.
+    Both rows compile the FULL generation into one jit, so — unlike the
+    `mode=chain` rows — decode_ms_per_token here INCLUDES prefill and
+    one per-call dispatch, amortized over decode_len. Compare gen1 rows
+    only with other gen1 rows."""
+    from hyperion_tpu.infer.generate import generate
+    from hyperion_tpu.infer.speculative import generate_speculative
+
+    cfg, model, params = _init_model(name)
+    variables = {"params": params}
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(1, cfg.vocab_size, (1, prompt_len)),
+        jnp.int32,
+    )
+    plain = jax.jit(lambda v, i: generate(model, v, i, decode_len))
+    spec = jax.jit(lambda v, i: generate_speculative(
+        model, v, model, v, i, decode_len, k=k))
+    rows = []
+    for mode, fn in (("gen1_plain", plain), ("gen1_spec_ceiling", spec)):
+        t = time_fn(fn, variables, ids, warmup=1, iters=3)
+        rows.append({
+            "model": name, "mode": mode, "quant": "none", "batch": 1,
+            "prompt_len": prompt_len,
+            "prefill_ms": float("nan"),
+            "decode_ms_per_token": round(t.median_ms / decode_len, 4),
+            "decode_tokens_per_s": round(decode_len / (t.median_ms / 1e3), 1),
+            "dispatch_overhead_ms": float("nan"),
+            "decode_live_mb": round(live_bytes_in_use() / 1e6, 2),
+            "lifetime_peak_mb": round(peak_bytes_in_use() / 1e6, 2),
+            "params_m": round(
+                sum(x.size for x in jax.tree.leaves(params)) / 1e6, 1),
+        })
+        print(f"[decode_bench] {json.dumps(rows[-1])}")
+    return rows
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--models", nargs="*", default=["tiny", "mid"],
@@ -135,6 +185,10 @@ def main(argv=None) -> None:
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--decode-len", type=int, default=64)
+    p.add_argument("--speculative", action="store_true",
+                   help="add batch-1 plain vs speculative-ceiling rows "
+                        "(whole-generation jit; separate compiles, so "
+                        "opt-in)")
     p.add_argument("--out", default="results/benchmarks/decode")
     args = p.parse_args(argv)
 
@@ -157,12 +211,20 @@ def main(argv=None) -> None:
                     quant=quant,
                 )
             except Exception as e:  # one model's OOM must not kill the sweep
-                print(f"[decode_bench] {name}/{quant} failed: "
-                      f"{str(e).splitlines()[0]}")
+                msg = str(e).splitlines()[0] if str(e) else repr(e)
+                print(f"[decode_bench] {name}/{quant} failed: {msg}")
                 continue
             rows.append(r)
             flush()
             print(f"[decode_bench] {json.dumps(r)}")
+        if args.speculative:
+            try:
+                rows.extend(benchmark_speculative(
+                    name, args.prompt_len, args.decode_len))
+                flush()
+            except Exception as e:  # noqa: BLE001 — per-variant tolerance
+                msg = str(e).splitlines()[0] if str(e) else repr(e)
+                print(f"[decode_bench] {name}/speculative failed: {msg}")
     if rows:
         print(f"[decode_bench] results in {out}/")
 

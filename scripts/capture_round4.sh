@@ -167,6 +167,13 @@ stage 1800 mfu_probe bash -c \
   "set -o pipefail; python scripts/mfu_probe.py | tee $OUT/hardware/mfu_probe.json"
 commit "Real-chip capture: MFU chain-variant probe at 8192^2" "$OUT"
 
+# 11. Speculative-decode ceiling rows (batch-1 whole-generation jit,
+#     plain vs self-draft) — separate stage: two extra whole-program
+#     compiles must not endanger the main decode capture.
+stage 1800 decode_spec python -m hyperion_tpu.bench.decode_bench \
+  --models mid --quant --speculative --out "$OUT/decode_spec"
+commit "Real-chip capture: speculative-decode ceiling rows" "$OUT"
+
 echo "[capture] artifacts:"
 find "$OUT" "$RUNS" -type f | sort
 if [ "$FAILED" -ne 0 ]; then
