@@ -189,6 +189,9 @@ def main(argv=None) -> None:
                    help="add batch-1 plain vs speculative-ceiling rows "
                         "(whole-generation jit; separate compiles, so "
                         "opt-in)")
+    p.add_argument("--no-chain", action="store_true",
+                   help="skip the chained per-token rows (e.g. a "
+                        "speculative-only capture stage)")
     p.add_argument("--out", default="results/benchmarks/decode")
     args = p.parse_args(argv)
 
@@ -204,7 +207,7 @@ def main(argv=None) -> None:
             w.writerows(rows)
 
     for name in args.models:
-        for quant in args.quant:
+        for quant in ([] if args.no_chain else args.quant):
             try:
                 r = benchmark_decode(
                     name, args.batch, args.prompt_len, args.decode_len,

@@ -80,6 +80,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler trace of the first epoch "
                         "into this directory (TensorBoard/XProf format)")
     p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--lr-schedule", default="constant",
+                   choices=["constant", "cosine", "warmup_cosine"],
+                   help="LR decay over the run (beyond the reference's "
+                        "fixed LR); schedules are step-functions inside "
+                        "the jitted update")
+    p.add_argument("--warmup-steps", type=int, default=0)
     p.add_argument("--grad_accum", type=int, default=1)
     p.add_argument("--remat",
                    choices=["none", "full", "dots", "dots_no_batch"],
@@ -117,6 +123,8 @@ def make_config(args, job: str) -> Config:
     cfg.train.base_dir = args.base_dir
     cfg.train.batch_size = args.batch_size or d["batch_size"]
     cfg.train.learning_rate = args.lr or d["learning_rate"]
+    cfg.train.lr_schedule = args.lr_schedule
+    cfg.train.warmup_steps = args.warmup_steps
     cfg.train.weight_decay = d.get("weight_decay", 0.0)
     cfg.train.steps_per_epoch = args.steps_per_epoch
     cfg.train.validate = not args.no_validate

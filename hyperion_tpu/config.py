@@ -82,6 +82,8 @@ class TrainConfig:
     epochs: int = 3
     batch_size: int = 32             # per-step GLOBAL batch
     learning_rate: float = 2e-4
+    lr_schedule: str = "constant"    # constant | cosine | warmup_cosine
+    warmup_steps: int = 0            # warmup_cosine's linear ramp length
     weight_decay: float = 0.0
     seq_len: int = 128               # reference tokenization window
     steps_per_epoch: int = 0         # 0 = full pass; >0 caps steps (smoke/bench runs)

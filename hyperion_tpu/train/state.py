@@ -57,16 +57,51 @@ def make_optimizer(
     grad_clip_norm: float = 0.0,
     b1: float = 0.9,
     b2: float = 0.999,
+    schedule: str = "constant",
+    warmup_steps: int = 0,
+    total_steps: int = 0,
 ) -> optax.GradientTransformation:
     """AdamW matching the reference's optimizers (AdamW everywhere —
     distributed_utils.py:161,231,334,503) with optional global-norm
-    clipping (the FSDP loops' clip_grad_norm_(1.0), :351,522)."""
+    clipping (the FSDP loops' clip_grad_norm_(1.0), :351,522).
+
+    Beyond reference parity (fixed LR there), `schedule` adds the
+    standard decays: "cosine" (to 0 over `total_steps`) and
+    "warmup_cosine" (linear 0 → lr over `warmup_steps`, then cosine).
+    Schedules are pure functions of the optimizer step count, so they
+    live inside the jitted update — no host involvement per step — and
+    resume correctly from a checkpointed opt_state."""
+    if schedule == "constant":
+        lr = learning_rate
+    elif schedule in ("cosine", "warmup_cosine"):
+        if total_steps <= 0:
+            raise ValueError(
+                f"schedule {schedule!r} needs total_steps > 0 "
+                f"(got {total_steps})"
+            )
+        if schedule == "cosine":
+            lr = optax.cosine_decay_schedule(learning_rate, total_steps)
+        else:
+            if warmup_steps <= 0:
+                raise ValueError(
+                    "warmup_cosine needs warmup_steps > 0 (a zero "
+                    "warmup silently degenerates into plain cosine — "
+                    "pass --warmup-steps or use schedule='cosine')"
+                )
+            warmup = min(warmup_steps, total_steps - 1)
+            lr = optax.warmup_cosine_decay_schedule(
+                init_value=0.0, peak_value=learning_rate,
+                warmup_steps=warmup, decay_steps=total_steps,
+            )
+    else:
+        raise ValueError(
+            f"unknown schedule {schedule!r} "
+            "(constant | cosine | warmup_cosine)"
+        )
     steps = []
     if grad_clip_norm and grad_clip_norm > 0:
         steps.append(optax.clip_by_global_norm(grad_clip_norm))
-    steps.append(
-        optax.adamw(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay)
-    )
+    steps.append(optax.adamw(lr, b1=b1, b2=b2, weight_decay=weight_decay))
     return optax.chain(*steps)
 
 

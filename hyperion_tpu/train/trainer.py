@@ -77,6 +77,25 @@ class TrainResult:
         return self.history[-1].loss if self.history else float("nan")
 
 
+def _steps_per_epoch(cfg: Config, batches) -> int:
+    """Optimizer steps one epoch actually runs: the dataset's batch
+    count, capped by cfg.train.steps_per_epoch (0 = full pass). The ONE
+    place this formula lives — the epoch loop's cap, the LR-schedule
+    horizon, and the run summary all divide through it."""
+    return min(len(batches), cfg.train.steps_per_epoch or len(batches))
+
+
+def _opt_kwargs(cfg: Config, batches) -> dict:
+    """Schedule plumbing shared by every driver: total optimizer steps
+    = capped steps/epoch x epochs (one update per step regardless of
+    grad accumulation — accumulation happens inside the step)."""
+    return {
+        "schedule": cfg.train.lr_schedule,
+        "warmup_steps": cfg.train.warmup_steps,
+        "total_steps": _steps_per_epoch(cfg, batches) * cfg.train.epochs,
+    }
+
+
 def _mean_of(metric_stack: list[dict], key: str) -> float:
     """Epoch-end mean of a per-step metric, reduced ON DEVICE.
 
@@ -289,7 +308,7 @@ def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int,
     ckpt_dir = (
         f"{cfg.train.base_dir}/checkpoints/{job}_{n_devices}dev{tree_tag}"
     )
-    steps_per_epoch = min(len(batches), cfg.train.steps_per_epoch or len(batches))
+    steps_per_epoch = _steps_per_epoch(cfg, batches)
     if steps_per_epoch <= 0:
         raise ValueError(
             f"zero steps per epoch: batch_size {cfg.train.batch_size} vs "
@@ -441,7 +460,7 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
         ))
     optimizer = make_optimizer(
         cfg.train.learning_rate, cfg.train.weight_decay,
-        cfg.optimization.grad_clip_norm,
+        cfg.optimization.grad_clip_norm, **_opt_kwargs(cfg, batches),
     )
     rng = jax.random.key(cfg.train.seed)
     state, sharding = create_train_state(
@@ -561,7 +580,7 @@ def train_cifar_model(cfg: Config, job: str = "cifar_ddp") -> TrainResult:
     model = resnet18(dtype="bfloat16" if policy.compute_dtype == jnp.bfloat16 else "float32")
     optimizer = make_optimizer(
         cfg.train.learning_rate, cfg.train.weight_decay,
-        cfg.optimization.grad_clip_norm,
+        cfg.optimization.grad_clip_norm, **_opt_kwargs(cfg, batches),
     )
     rng = jax.random.key(cfg.train.seed)
     state, sharding = create_train_state(
@@ -706,7 +725,7 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
 
     adamw = make_optimizer(
         cfg.train.learning_rate, cfg.train.weight_decay,
-        cfg.optimization.grad_clip_norm,
+        cfg.optimization.grad_clip_norm, **_opt_kwargs(cfg, batches),
     )
     if cfg.train.lora:
         optimizer = optax.multi_transform(
@@ -788,7 +807,7 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
 
         from hyperion_tpu.utils.memory import peak_bytes_in_use
 
-        steps = min(len(batches), cfg.train.steps_per_epoch or len(batches))
+        steps = _steps_per_epoch(cfg, batches)
         toks_per_epoch = cfg.train.batch_size * cfg.train.seq_len * steps
         best_s = min(h.duration_s for h in history)
         summary = {

@@ -171,7 +171,7 @@ commit "Real-chip capture: MFU chain-variant probe at 8192^2" "$OUT"
 #     plain vs self-draft) — separate stage: two extra whole-program
 #     compiles must not endanger the main decode capture.
 stage 1800 decode_spec python -m hyperion_tpu.bench.decode_bench \
-  --models mid --quant --speculative --out "$OUT/decode_spec"
+  --models mid --no-chain --speculative --out "$OUT/decode_spec"
 commit "Real-chip capture: speculative-decode ceiling rows" "$OUT"
 
 echo "[capture] artifacts:"

@@ -163,6 +163,47 @@ class TestTrainerDrivers:
         assert 0.0 <= acc <= 100.0
 
 
+class TestLrSchedules:
+    """make_optimizer's schedule arm (beyond the reference's fixed LR)."""
+
+    def _update_mags(self, opt, n):
+        import optax
+
+        params = {"w": jnp.ones((4,))}
+        state = opt.init(params)
+        mags = []
+        for _ in range(n):
+            upd, state = opt.update({"w": jnp.ones((4,))}, state, params)
+            params = optax.apply_updates(params, upd)
+            mags.append(float(jnp.abs(upd["w"]).mean()))
+        return mags
+
+    def test_warmup_then_decay(self):
+        from hyperion_tpu.train.state import make_optimizer
+
+        opt = make_optimizer(1e-2, schedule="warmup_cosine",
+                             warmup_steps=5, total_steps=20)
+        mags = self._update_mags(opt, 20)
+        assert mags[0] < mags[4] < mags[5] * 1.5   # ramping up
+        assert mags[19] < mags[6]                  # decaying down
+
+    def test_cosine_decays(self):
+        from hyperion_tpu.train.state import make_optimizer
+
+        mags = self._update_mags(
+            make_optimizer(1e-2, schedule="cosine", total_steps=10), 10
+        )
+        assert mags[-1] < mags[0]
+
+    def test_schedule_validation(self):
+        from hyperion_tpu.train.state import make_optimizer
+
+        with pytest.raises(ValueError, match="total_steps"):
+            make_optimizer(1e-2, schedule="cosine")
+        with pytest.raises(ValueError, match="unknown schedule"):
+            make_optimizer(1e-2, schedule="linear")
+
+
 class TestCheckpoint:
     def test_roundtrip_and_resume_layout(self, lm_setup, tmp_path):
         from hyperion_tpu import checkpoint as ckpt
