@@ -68,6 +68,15 @@ def sample_token(logits: jax.Array, rng: jax.Array | None,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def _cfg_attr(cfg, name: str):
+    """Config field lookup that sees through MoELMConfig's nesting
+    (`cfg.name`, else `cfg.base.name`)."""
+    val = getattr(cfg, name, None)
+    if val is None:
+        val = getattr(getattr(cfg, "base", cfg), name, None)
+    return val
+
+
 def _step_rngs(rng, n, temperature=0.0):
     if rng is None:
         if temperature > 0.0:
@@ -153,9 +162,7 @@ def generate_recompute(
     step. Causality makes the zero future positions invisible."""
     B, P = prompt_ids.shape
     width = P + max_new_tokens
-    max_len = getattr(model.cfg, "max_len", None) or getattr(
-        model.cfg, "base", model.cfg
-    ).max_len
+    max_len = _cfg_attr(model.cfg, "max_len")
     if width > max_len:
         raise ValueError(f"{width} tokens exceeds max_len {max_len}")
     buf = jnp.zeros((B, width), jnp.int32)
@@ -222,18 +229,64 @@ def _infer_llama_from_npz(params: dict, max_len: int):
     return Llama(cfg)
 
 
-def model_from_npz(params: dict, max_len: int = 4096):
+def _infer_moe_from_npz(params: dict, moe_top_k: int):
+    """Rebuild an MoELM from a gathered export. Architecture comes from
+    the weights (expert bank shapes, the dense/sparse block pattern);
+    routing top_k is NOT in the weights — it's a CLI knob that must
+    match training for outputs to match the trained router's regime."""
+    from hyperion_tpu.models.moe_lm import MoELM, MoELMConfig
+    from hyperion_tpu.models.transformer_lm import simple_lm_config
+    from hyperion_tpu.ops.moe import MoEConfig
+
+    vocab, d_model = params["tok_emb"]["embedding"].shape
+    max_len = params["pos_emb"]["embedding"].shape[0]
+    moe_idx = sorted(
+        int(k.split("_")[-1]) for k in params if k.startswith("moe_block_")
+    )
+    dense_idx = [int(k.split("_")[-1]) for k in params
+                 if k.startswith("block_")]
+    n_layers = len(moe_idx) + len(dense_idx)
+    # blocks (i+1) % moe_every == 0 are sparse: the first sparse index
+    # recovers the cadence (all-MoE → first index 0 → every 1)
+    moe_every = moe_idx[0] + 1
+    bank = params[f"moe_block_{moe_idx[0]}"]["experts"]
+    E, _, moe_ff = bank["wi"].shape
+    first = params[f"block_{dense_idx[0]}"] if dense_idx \
+        else params[f"moe_block_{moe_idx[0]}"]
+    n_heads = first["attn"]["q_proj"]["kernel"].shape[1]
+    ff_dim = (params[f"block_{dense_idx[0]}"]["fc1"]["kernel"].shape[1]
+              if dense_idx else moe_ff)
+    if not 1 <= moe_top_k <= E:
+        raise ValueError(
+            f"--moe-top-k {moe_top_k} out of range for this export's "
+            f"{E} experts (need 1..{E}, matching training)"
+        )
+    base = simple_lm_config(
+        vocab_size=vocab, d_model=d_model, n_layers=n_layers,
+        ff_dim=ff_dim, n_heads=n_heads, max_len=max_len, dropout=0.0,
+    )
+    # the trainer wires moe.activation = base.activation (trainer.py);
+    # neither is recoverable from weights, so both ride the same default
+    moe = MoEConfig(n_experts=E, top_k=moe_top_k, d_model=d_model,
+                    ff_dim=moe_ff, activation=base.activation)
+    return MoELM(MoELMConfig(base=base, moe=moe, moe_every=moe_every))
+
+
+def model_from_npz(params: dict, max_len: int = 4096, moe_top_k: int = 2):
     """(model, cached: bool) for a gathered export — Llama exports get
-    the KV-cache decode path, TransformerLM exports the recompute one.
-    MoE/pipeline exports are rejected with a clear message rather than
-    rebuilt wrong."""
+    the KV-cache decode path; TransformerLM and MoELM exports the
+    recompute one. Pipeline exports are rejected with a clear message
+    rather than rebuilt wrong."""
     if "embed_tokens" in params:
         return _infer_llama_from_npz(params, max_len), True
-    if any(k.startswith("moe_block_") for k in params) or "stages" in params:
+    if "stages" in params:
         raise ValueError(
-            "MoE/pipeline checkpoints are not supported by the generation "
-            "CLI yet — export a dense TransformerLM or Llama checkpoint"
+            "pipeline checkpoints are not supported by the generation "
+            "CLI — export a dense TransformerLM, MoELM, or Llama "
+            "checkpoint"
         )
+    if any(k.startswith("moe_block_") for k in params):
+        return _infer_moe_from_npz(params, moe_top_k), False
     if "tok_emb" not in params:
         raise ValueError(
             f"unrecognized checkpoint layout (top-level keys: "
@@ -272,14 +325,23 @@ def main(argv=None) -> int:
                         "only; same vocab; infer/speculative.py)")
     p.add_argument("--draft-k", type=int, default=4,
                    help="speculative proposals per verify round")
+    p.add_argument("--moe-top-k", type=int, default=2,
+                   help="MoE exports: routing top_k (not recoverable "
+                        "from weights; must match training)")
     args = p.parse_args(argv)
 
     tok = ByteBPE.load(args.tokenizer_dir)
     params = load_gathered(args.ckpt)
-    model, cached = model_from_npz(params, args.max_len)
+    model, cached = model_from_npz(params, args.max_len, args.moe_top_k)
     if args.quant == "int8":
+        from hyperion_tpu.models.transformer_lm import TransformerLMConfig
         from hyperion_tpu.precision.quant import quantize_llama, quantize_lm
 
+        if not cached and not isinstance(model.cfg, TransformerLMConfig):
+            raise SystemExit(
+                "--quant int8 supports Llama and TransformerLM exports "
+                "(MoE expert banks are einsum weights, not dense kernels)"
+            )
         quantize = quantize_llama if cached else quantize_lm
         model, params = quantize(params, model.cfg)
     if args.draft_ckpt:
@@ -333,10 +395,11 @@ def main(argv=None) -> int:
                 top_p=args.top_p, rng=rng,
             )
         )
-    if tok.vocab_size > model.cfg.vocab_size:
+    model_vocab = _cfg_attr(model.cfg, "vocab_size")
+    if model_vocab and tok.vocab_size > model_vocab:
         print(
             f"[generate] warning: tokenizer vocab {tok.vocab_size} exceeds "
-            f"model vocab {model.cfg.vocab_size} — prompt ids above the "
+            f"model vocab {model_vocab} — prompt ids above the "
             "model's range would be silently clamped by the embedding "
             "lookup; retrain the tokenizer at or below the model vocab"
         )

@@ -197,6 +197,42 @@ class TestGenerationCLI:
         assert rc == 0
 
     @pytest.mark.slow
+    def test_main_moe_export(self, tmp_path):
+        """MoELM export -> CLI generation (recompute path, architecture
+        rebuilt from the expert-bank shapes + block pattern)."""
+        from hyperion_tpu.checkpoint.io import export_gathered
+        from hyperion_tpu.data.bpe import train_bpe
+        from hyperion_tpu.infer.generate import main, model_from_npz
+        from hyperion_tpu.models.moe_lm import MoELM, MoELMConfig
+        from hyperion_tpu.ops.moe import MoEConfig
+
+        tok = train_bpe(["the quick brown fox jumps over the lazy dog"] * 4,
+                        vocab_size=256, verbose=False)
+        tok.save(tmp_path / "tok")
+        base = simple_lm_config(
+            vocab_size=tok.vocab_size, d_model=32, n_heads=4, n_layers=2,
+            ff_dim=64, max_len=32, dropout=0.0,
+        )
+        moe = MoEConfig(n_experts=4, top_k=2, d_model=32, ff_dim=64)
+        cfg = MoELMConfig(base=base, moe=moe, moe_every=2)
+        params = MoELM(cfg).init_params(jax.random.key(0))
+        export_gathered(tmp_path / "moe.npz", params)
+        # the reconstructor recovers the architecture exactly
+        from hyperion_tpu.checkpoint.io import load_gathered
+
+        model, cached = model_from_npz(load_gathered(tmp_path / "moe.npz"))
+        assert not cached
+        assert model.cfg.moe.n_experts == 4
+        assert model.cfg.moe_every == 2
+        assert model.cfg.base.n_layers == 2
+        rc = main([
+            "--prompt", "the quick", "--ckpt", str(tmp_path / "moe.npz"),
+            "--tokenizer-dir", str(tmp_path / "tok"),
+            "--max-new-tokens", "4",
+        ])
+        assert rc == 0
+
+    @pytest.mark.slow
     def test_main_speculative(self, tmp_path):
         """Target + draft Llama exports -> --draft-ckpt CLI decode."""
         from hyperion_tpu.checkpoint.io import export_gathered
