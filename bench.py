@@ -45,6 +45,12 @@ os.environ.setdefault(
 )
 
 BASELINE_TFLOPS_BF16_8192 = 121.07  # MI250X bf16 8192^2 (BASELINE.md)
+# Shared window-health thresholds vs the committed record (the axon tunnel
+# time-shares the chip, so windows vary far beyond run noise — 81.7 vs
+# 175.75 TFLOPS observed a day apart on the same chain). One definition
+# here; scripts/validate_headline.py imports these.
+CAPTURE_OK_FRACTION = 0.97  # within run noise: capture stage counts as done
+DEGRADED_FRACTION = 0.85    # below this: attach provenance to the live line
 N = int(os.environ.get("HYPERION_BENCH_N", "8192"))  # override for smoke tests
 PRIMARY_TIMEOUT_S = int(os.environ.get("HYPERION_BENCH_TIMEOUT", "600"))
 EXTRA_TIMEOUT_S = int(os.environ.get("HYPERION_BENCH_EXTRA_TIMEOUT", "420"))
@@ -298,17 +304,28 @@ def main() -> None:
         "device_kind": primary.get("device_kind", "unknown"),
         "measurement": primary,
     }
+    last = _last_committed()
     if not plausible:
         out["implausible"] = True
         out["note"] = (
             f"guard rejected measurement ({primary.get('checks')}): raw value "
             f"{primary['tflops']} TFLOPS not published"
         )
-        last = _last_committed()
         if last is not None:
             out["last_committed"] = last
     elif N != 8192:
         out["note"] = f"smoke run at N={N}; vs_baseline only defined at N=8192"
+    elif last is not None and out["value"] < DEGRADED_FRACTION * last["value"]:
+        # A live-but-degraded window (tunnel tenancy contention) publishes
+        # the live number — it IS the measurement — with the committed
+        # record attached so the driver's log distinguishes contention
+        # from a perf regression.
+        out["last_committed"] = last
+        out["note"] = (
+            "live window measured below the committed record "
+            f"({out['value']} vs {last['value']} {last['unit']}); the "
+            "tunnel time-shares the chip — see last_committed provenance"
+        )
     extra, extra_err = _run_child("--child-lm-step", EXTRA_TIMEOUT_S)
     if extra is not None:
         out["extra"] = extra

@@ -4,24 +4,34 @@
 Reads results/benchmarks/bench_live_latest.json (just written by
 `python bench.py | tee ...`). bench_live.json is the *best verified
 capture* record — the file bench.py's `last_committed` fallback reads
-from HEAD when the tunnel is dead at round end. Promotion is monotonic:
-a live headline only replaces it when it is at least as good as the
-committed one. The axon tunnel time-shares the chip, so a window can
-measure far below the hardware's demonstrated rate (2026-07-31: 81.7
-TFLOPS on the same chain that measured 175.75 the day before, dispatch
-overhead 167 ms vs the usual ~65 ms); recording that as "the framework's
-number" would report tenancy contention as a perf regression. The
-latest measurement is always preserved verbatim in
+from HEAD when the tunnel is dead at round end. Two independent
+decisions, sharing bench.py's window-health thresholds:
+
+- **Record update** is strictly monotonic: the file only changes when
+  the live value beats it, so a degraded tunnel window can never
+  overwrite the record (2026-07-31: 81.7 TFLOPS measured on the same
+  chain that recorded 175.75 the day before — tenancy contention, not
+  a regression), and repeated within-noise windows cannot ratchet it
+  downward either.
+- **Stage outcome**: exit 0 (stamp the stage, stop retrying) when the
+  live value is within run noise of the record (>= CAPTURE_OK_FRACTION
+  x) — otherwise every healthy-but-not-record window would fail the
+  stage and burn a bench run per watcher retry all round. Below that:
+  exit 1 so the watcher retries on a later, hopefully uncontended,
+  window. Unparseable/zero headlines always exit 1.
+
+The latest measurement is always preserved verbatim in
 bench_live_latest.json, so nothing is hidden — the two files differing
 IS the signal that the last window was degraded.
-
-Exit 1 (stage fails, watcher retries): unparseable/zero headline, or a
-live value that did not beat the committed record.
 """
 
 import json
+import os
 import shutil
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import CAPTURE_OK_FRACTION  # noqa: E402 — one shared definition
 
 LATEST = "results/benchmarks/bench_live_latest.json"
 GOOD = "results/benchmarks/bench_live.json"
@@ -45,6 +55,11 @@ except Exception:  # noqa: BLE001 — no committed record yet: any good value pr
 if live >= best:
     shutil.copy(LATEST, GOOD)
     print(f"[capture] headline {live} >= committed {best}; bench_live.json updated")
+elif live >= CAPTURE_OK_FRACTION * best:
+    print(
+        f"[capture] headline {live} within noise of committed {best}; "
+        "record kept, stage complete"
+    )
 else:
     print(
         f"[capture] headline {live} below committed {best} (degraded window); "

@@ -176,3 +176,60 @@ class TestCompareToReference:
         out = self._run(tmp_path, capsys)
         assert "175.75" in out
         assert "resnet50" in out and "2.01x" in out  # 1142.9/568.22
+
+
+class TestValidateHeadline:
+    """Headline promotion (scripts/validate_headline.py) is monotonic:
+    a degraded tunnel window must not overwrite the committed record
+    (2026-07-31: the time-shared chip measured 81.7 TFLOPS on the same
+    chain that recorded 175.75 the day before)."""
+
+    SCRIPT = Path(__file__).parent.parent / "scripts" / "validate_headline.py"
+
+    def _run(self, tmp_path, latest=None, good=None):
+        import subprocess
+        import sys
+
+        out = tmp_path / "results" / "benchmarks"
+        out.mkdir(parents=True, exist_ok=True)
+        if latest is not None:
+            (out / "bench_live_latest.json").write_text(latest)
+        if good is not None:
+            (out / "bench_live.json").write_text(good)
+        proc = subprocess.run(
+            [sys.executable, str(self.SCRIPT)], cwd=tmp_path,
+            capture_output=True, text=True, timeout=60,
+        )
+        good_path = out / "bench_live.json"
+        return proc.returncode, (
+            good_path.read_text() if good_path.exists() else None
+        )
+
+    def test_first_capture_promotes(self, tmp_path):
+        rc, good = self._run(tmp_path, latest='{"value": 100.0}\n')
+        assert rc == 0 and '"value": 100.0' in good
+
+    def test_better_value_promotes(self, tmp_path):
+        rc, good = self._run(
+            tmp_path, latest='{"value": 180.0}\n', good='{"value": 175.75}\n')
+        assert rc == 0 and '"value": 180.0' in good
+
+    def test_degraded_window_keeps_record_and_fails_stage(self, tmp_path):
+        rc, good = self._run(
+            tmp_path, latest='{"value": 81.69}\n', good='{"value": 175.75}\n')
+        assert rc == 1 and '"value": 175.75' in good
+
+    def test_zero_headline_fails_stage(self, tmp_path):
+        rc, good = self._run(
+            tmp_path, latest='{"value": 0.0, "error": "x"}\n',
+            good='{"value": 175.75}\n')
+        assert rc == 1 and '"value": 175.75' in good
+
+    def test_missing_latest_fails_stage(self, tmp_path):
+        rc, _ = self._run(tmp_path, latest=None, good='{"value": 175.75}\n')
+        assert rc == 1
+
+    def test_within_noise_window_stamps_without_ratchet(self, tmp_path):
+        rc, good = self._run(
+            tmp_path, latest='{"value": 175.0}\n', good='{"value": 175.75}\n')
+        assert rc == 0 and '"value": 175.75' in good  # record untouched
