@@ -24,7 +24,6 @@ CLI: `python -m hyperion_tpu.bench.baseline [--models ...] [--batch-sizes ...]`.
 from __future__ import annotations
 
 import argparse
-import csv
 import json
 from pathlib import Path
 from typing import Callable
@@ -34,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from hyperion_tpu.bench.util import write_csv as _write_csv
 from hyperion_tpu.models.encoder import TransformerEncoder, custom_transformer_config
 from hyperion_tpu.models.resnet import resnet50
 from hyperion_tpu.models.vit import ViT, vit_b16_config
@@ -181,16 +181,6 @@ def precision_comparison(
 ) -> list[dict]:
     """C15's `compare_precision_formats`."""
     return [benchmark_model(name, batch, dt, iters=iters) for dt in dtypes]
-
-
-def _write_csv(path: Path, rows: list[dict]) -> None:
-    if not rows:
-        return
-    path.parent.mkdir(parents=True, exist_ok=True)
-    with path.open("w", newline="") as f:
-        w = csv.DictWriter(f, fieldnames=list(rows[0]))
-        w.writeheader()
-        w.writerows(rows)
 
 
 def main(argv=None) -> None:

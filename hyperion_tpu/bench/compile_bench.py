@@ -27,7 +27,6 @@ CLI: `python -m hyperion_tpu.bench.compile_bench [--dtype bf16] [--repeat N]
 from __future__ import annotations
 
 import argparse
-import csv
 import json
 from pathlib import Path
 
@@ -37,6 +36,7 @@ import numpy as np
 
 from hyperion_tpu.models.resnet import resnet18
 from hyperion_tpu.models.transformer_lm import TransformerLM, gpt2_lm_config
+from hyperion_tpu.bench.util import write_csv
 from hyperion_tpu.utils.timing import time_chained, time_fn
 
 
@@ -269,10 +269,7 @@ def main(argv=None) -> None:
 
     def sink(row: dict) -> None:
         flushed.append(row)
-        with (out / "compilation_benchmark.csv").open("w", newline="") as f:
-            w = csv.DictWriter(f, fieldnames=list(flushed[0]))
-            w.writeheader()
-            w.writerows(flushed)
+        write_csv(out / "compilation_benchmark.csv", flushed)
         (out / "compilation_benchmark.json").write_text(
             json.dumps(flushed, indent=2))
 

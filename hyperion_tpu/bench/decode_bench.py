@@ -15,7 +15,6 @@ CLI: `python -m hyperion_tpu.bench.decode_bench [--models tiny mid]
 from __future__ import annotations
 
 import argparse
-import csv
 import json
 from pathlib import Path
 
@@ -23,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hyperion_tpu.bench.util import write_csv
 from hyperion_tpu.models.llama import Llama, init_cache, llama_tiny_config
 from hyperion_tpu.utils.memory import live_bytes_in_use, peak_bytes_in_use
 from hyperion_tpu.utils.timing import time_chained, time_fn
@@ -200,11 +200,7 @@ def main(argv=None) -> None:
 
     def flush() -> None:
         # incremental: rows measured before a capture-stage SIGTERM stay
-        out.mkdir(parents=True, exist_ok=True)
-        with (out / "decode_benchmarks.csv").open("w", newline="") as f:
-            w = csv.DictWriter(f, fieldnames=list(rows[0]))
-            w.writeheader()
-            w.writerows(rows)
+        write_csv(out / "decode_benchmarks.csv", rows)
 
     for name in args.models:
         for quant in ([] if args.no_chain else args.quant):

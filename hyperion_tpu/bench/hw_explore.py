@@ -21,13 +21,13 @@ CLI: `python -m hyperion_tpu.bench.hw_explore [--sizes ...] [--out dir]`.
 from __future__ import annotations
 
 import argparse
-import csv
 import json
 from pathlib import Path
 
 import jax
 import jax.numpy as jnp
 
+from hyperion_tpu.bench.util import write_csv as _write_csv
 from hyperion_tpu.metrics.plots import plot_bandwidth, plot_matmul_tflops, try_plot
 from hyperion_tpu.utils.chips import mfu as chip_mfu
 from hyperion_tpu.utils.chips import nominal_peak_tflops
@@ -153,14 +153,6 @@ def memory_bandwidth(
     return rows
 
 
-def _write_csv(path: Path, rows: list[dict]) -> None:
-    if not rows:
-        return
-    path.parent.mkdir(parents=True, exist_ok=True)
-    with path.open("w", newline="") as f:
-        w = csv.DictWriter(f, fieldnames=list(rows[0]))
-        w.writeheader()
-        w.writerows(rows)
 
 
 def main(argv=None) -> None:

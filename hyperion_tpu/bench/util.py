@@ -1,0 +1,28 @@
+"""Shared helpers for the benchmark suite."""
+
+from __future__ import annotations
+
+import csv
+from pathlib import Path
+
+
+def write_csv(path: Path | str, rows: list[dict]) -> None:
+    """Write dict rows, creating parents; no-op on empty.
+
+    Fieldnames are the first-seen-order union over ALL rows (error rows
+    may add columns like "note" that ok rows lack; a first-row-only
+    header would make DictWriter raise on them), missing keys render as
+    "". Callers flush after every appended row so a capture stage killed
+    at its time limit still leaves the measured rows on disk.
+    """
+    if not rows:
+        return
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fields: list[str] = []
+    for r in rows:
+        fields.extend(k for k in r if k not in fields)
+    with path.open("w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=fields, restval="")
+        w.writeheader()
+        w.writerows(rows)

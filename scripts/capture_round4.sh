@@ -129,6 +129,13 @@ commit "Real-chip capture: compile-tier benchmark (C14)" "$OUT"
 stage 1800 decode_bench python -m hyperion_tpu.bench.decode_bench --out "$OUT/decode"
 commit "Real-chip capture: decode benchmark" "$OUT"
 
+# 4b. Long-seq attention scaling: XLA vs Pallas flash at 1k-16k (the
+#    SURVEY §5.7 long-context evidence; an xla OOM row at 16k is a
+#    finding, not a failure).
+stage 2400 attention_bench python -m hyperion_tpu.bench.attention_bench \
+  --out "$OUT/attention"
+commit "Real-chip capture: long-seq attention scaling (xla vs pallas flash)" "$OUT"
+
 # 5-6. Real training runs at the reference's epoch counts (VERDICT
 #    item 2), on the full-size synthetic corpora (see
 #    results/tpu_runs/README.md for steps/epoch parity).

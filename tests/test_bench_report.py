@@ -233,3 +233,33 @@ class TestValidateHeadline:
         rc, good = self._run(
             tmp_path, latest='{"value": 175.0}\n', good='{"value": 175.75}\n')
         assert rc == 0 and '"value": 175.75' in good  # record untouched
+
+
+class TestAttentionBench:
+    """Long-seq attention scaling bench (bench/attention_bench.py):
+    row shape, CSV union-fieldnames, and error rows must not kill the
+    sweep (an OOM row is the finding, not a crash)."""
+
+    def test_ok_row_and_csv(self, tmp_path, capsys):
+        from hyperion_tpu.bench import attention_bench
+
+        attention_bench.main([
+            "--seqs", "128", "--impls", "xla", "--modes", "fwd",
+            "--dtype", "float32", "--out", str(tmp_path)])
+        rows = list(csv.DictReader(
+            (tmp_path / "attention_scaling.csv").open()))
+        assert len(rows) == 1 and rows[0]["status"] == "ok"
+        assert float(rows[0]["per_iter_ms"]) > 0
+        assert float(rows[0]["achieved_tflops"]) > 0
+
+    def test_error_row_records_note(self, tmp_path):
+        from hyperion_tpu.bench.attention_bench import benchmark_attention
+        from hyperion_tpu.bench.util import write_csv as _write_csv
+
+        ok = benchmark_attention(128, "xla", "fwd", "float32")
+        bad = benchmark_attention(128, "definitely-not-an-impl", "fwd")
+        assert bad["status"] == "error" and "impl" in bad["note"]
+        # union fieldnames: ok row lacks "note", error row adds it
+        _write_csv(tmp_path / "mixed.csv", [ok, bad])
+        rows = list(csv.DictReader((tmp_path / "mixed.csv").open()))
+        assert rows[0]["note"] == "" and rows[1]["status"] == "error"
