@@ -74,6 +74,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "probing real devices (never blocks on a dead "
                         "TPU tunnel); default: auto-detect")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--dry-init", action="store_true",
+                   help="plan-only: eval_shape the TrainState and print "
+                        "the memory plan (global/per-device bytes, param "
+                        "count) without touching a device — sanity-check "
+                        "a 7B config on any box")
     p.add_argument("--no-validate", action="store_true",
                    help="skip the per-epoch validation pass")
     p.add_argument("--profile-dir", default="",
@@ -128,6 +133,7 @@ def make_config(args, job: str) -> Config:
     cfg.train.weight_decay = d.get("weight_decay", 0.0)
     cfg.train.steps_per_epoch = args.steps_per_epoch
     cfg.train.validate = not args.no_validate
+    cfg.train.dry_init = args.dry_init
     cfg.train.profile_dir = args.profile_dir
     cfg.train.seed = args.seed
     cfg.train.lora = args.lora
@@ -178,7 +184,11 @@ def run_job(args, job: str):
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    p = build_parser()
+    args = p.parse_args(argv)
+    if args.dry_init and args.model == "scaling":
+        p.error("--dry-init plans a single job's TrainState; it does not "
+                "apply to the scaling sweep (pick one of its jobs instead)")
     dist.setup()
 
     if args.model == "scaling":

@@ -104,6 +104,10 @@ class TrainConfig:
     moe_experts: int = 0             # >0: language jobs use the MoE LM
     moe_top_k: int = 2
     moe_every: int = 2               # every k-th block is sparse
+    # plan-only mode: eval_shape the full TrainState (params/opt/sharding
+    # specs) and print the byte-accounting memory plan WITHOUT touching a
+    # device — validates e.g. the 7B config end-to-end on a CPU box
+    dry_init: bool = False
 
 
 @dataclasses.dataclass

@@ -105,25 +105,11 @@ def make_optimizer(
     return optax.chain(*steps)
 
 
-def create_train_state(
+def _make_build(
     init_variables: Callable[[jax.Array], dict],
     optimizer: optax.GradientTransformation,
-    mesh: Mesh,
-    rng: jax.Array,
-    policy: str | Policy = "bf16",
-    tp_rules: Sequence[Rule] | None = None,
-    fsdp: bool = True,
-    fsdp_min_size: int = 2**14,
-) -> tuple[TrainState, StateSharding]:
-    """Build a sharded TrainState.
-
-    `init_variables(rng)` returns the flax variables dict (params [+
-    batch_stats]). The state is created *on-device, already sharded*:
-    shapes come from `jax.eval_shape`, shardings from the parallel layer,
-    and the actual init runs under jit with those out_shardings.
-    """
-    policy = get_policy(policy)
-
+    policy: Policy,
+) -> Callable[[jax.Array], TrainState]:
     def build(rng):
         variables = init_variables(rng)
         params = policy.cast_to_param(variables["params"])
@@ -136,6 +122,74 @@ def create_train_state(
             batch_stats=batch_stats,
         )
 
+    return build
+
+
+def _spec_divisor(sharding: NamedSharding) -> int:
+    """How many ways a leaf with this sharding splits across devices."""
+    div = 1
+    for entry in sharding.spec:
+        for axis in (entry if isinstance(entry, tuple) else (entry,)):
+            if axis is not None:
+                div *= sharding.mesh.shape[axis]
+    return div
+
+
+def memory_plan(shapes: TrainState, sharding: StateSharding) -> dict:
+    """Byte accounting for a planned TrainState: global and per-device
+    totals by section, params additionally by dtype. Activations are
+    deliberately excluded — they depend on batch/seq/remat, not on the
+    state layout this module owns."""
+    import numpy as np
+
+    plan: dict = {"mesh": dict(sharding.mesh.shape)}
+    per_device = 0.0
+    total = 0
+    for section in ("params", "opt_state", "batch_stats"):
+        sec_total = 0
+        sec_dev = 0.0
+        leaves = jax.tree.leaves(getattr(shapes, section))
+        shard_leaves = jax.tree.leaves(getattr(sharding.tree, section))
+        for leaf, sh in zip(leaves, shard_leaves):
+            nbytes = int(np.prod(leaf.shape)) * jax.numpy.dtype(leaf.dtype).itemsize
+            sec_total += nbytes
+            sec_dev += nbytes / _spec_divisor(sh)
+        plan[f"{section}_gb"] = round(sec_total / 1e9, 4)
+        total += sec_total
+        per_device += sec_dev
+    by_dtype: dict[str, int] = {}
+    n_params = 0
+    for leaf in jax.tree.leaves(shapes.params):
+        n = int(np.prod(leaf.shape))
+        n_params += n
+        name = jax.numpy.dtype(leaf.dtype).name
+        by_dtype[name] = by_dtype.get(name, 0) + n * jax.numpy.dtype(leaf.dtype).itemsize
+    plan["param_count"] = n_params
+    plan["params_by_dtype_gb"] = {
+        k: round(v / 1e9, 4) for k, v in sorted(by_dtype.items())
+    }
+    plan["total_gb"] = round(total / 1e9, 4)
+    plan["per_device_gb"] = round(per_device / 1e9, 4)
+    return plan
+
+
+def plan_train_state(
+    init_variables: Callable[[jax.Array], dict],
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    rng: jax.Array,
+    policy: str | Policy = "bf16",
+    tp_rules: Sequence[Rule] | None = None,
+    fsdp: bool = True,
+    fsdp_min_size: int = 2**14,
+) -> tuple[TrainState, StateSharding, dict]:
+    """Shapes, shardings, and a memory plan — via `jax.eval_shape` only,
+    so no device memory (or device at all) is touched. This is how a
+    7B config is validated end-to-end (param tree, LoRA labels, TP/FSDP
+    specs, optimizer masking) on a laptop CPU before a chip ever sees
+    it; the trainers expose it as `--dry-init`."""
+    policy = get_policy(policy)
+    build = _make_build(init_variables, optimizer, policy)
     shapes = jax.eval_shape(build, rng)
     params_sh = named_shardings(
         shapes.params, mesh, tp_rules=tp_rules, fsdp=fsdp, fsdp_min_size=fsdp_min_size
@@ -151,5 +205,31 @@ def create_train_state(
             ),
         ),
     )
+    return shapes, sharding, memory_plan(shapes, sharding)
+
+
+def create_train_state(
+    init_variables: Callable[[jax.Array], dict],
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    rng: jax.Array,
+    policy: str | Policy = "bf16",
+    tp_rules: Sequence[Rule] | None = None,
+    fsdp: bool = True,
+    fsdp_min_size: int = 2**14,
+) -> tuple[TrainState, StateSharding]:
+    """Build a sharded TrainState.
+
+    `init_variables(rng)` returns the flax variables dict (params [+
+    batch_stats]). The state is created *on-device, already sharded*:
+    shapes come from `jax.eval_shape` (via `plan_train_state`), shardings
+    from the parallel layer, and the actual init runs under jit with
+    those out_shardings.
+    """
+    _, sharding, _ = plan_train_state(
+        init_variables, optimizer, mesh, rng, policy=policy,
+        tp_rules=tp_rules, fsdp=fsdp, fsdp_min_size=fsdp_min_size,
+    )
+    build = _make_build(init_variables, optimizer, get_policy(policy))
     state = jax.jit(build, out_shardings=sharding.tree)(rng)
     return state, sharding

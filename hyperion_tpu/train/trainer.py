@@ -50,7 +50,11 @@ from hyperion_tpu.precision.policy import get_policy
 from hyperion_tpu.runtime import dist
 from hyperion_tpu.runtime.mesh import make_mesh
 from hyperion_tpu.train.losses import classification_loss, next_token_loss
-from hyperion_tpu.train.state import create_train_state, make_optimizer
+from hyperion_tpu.train.state import (
+    create_train_state,
+    make_optimizer,
+    plan_train_state,
+)
 from hyperion_tpu.train.step import make_eval_step, make_train_step
 from hyperion_tpu.utils import profiling
 from hyperion_tpu.utils.timing import host_fence
@@ -75,6 +79,20 @@ class TrainResult:
     @property
     def final_loss(self) -> float:
         return self.history[-1].loss if self.history else float("nan")
+
+
+def _dry_init(job: str, init_variables, optimizer, mesh, rng, **kw) -> TrainResult:
+    """`--dry-init`: eval_shape the full TrainState and print the memory
+    plan (global + per-device bytes by section) without touching any
+    device — how a 7B config is sanity-checked on a CPU box before a
+    chip run. `kw` forwards policy/tp_rules/fsdp exactly as the real
+    create_train_state call would."""
+    import json
+
+    _, _, plan = plan_train_state(init_variables, optimizer, mesh, rng, **kw)
+    if dist.is_primary():
+        print(f"[{job}] dry-init memory plan: {json.dumps(plan)}")
+    return TrainResult(job, "dry_init", "", None, [])
 
 
 def _steps_per_epoch(cfg: Config, batches) -> int:
@@ -463,12 +481,17 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
         cfg.optimization.grad_clip_norm, **_opt_kwargs(cfg, batches),
     )
     rng = jax.random.key(cfg.train.seed)
+
+    def init_variables(r):
+        return {"params": model.init_params(r)}
+
+    # one kwargs dict for BOTH the plan and the real init: the --dry-init
+    # memory plan must describe the exact layout training would use
+    state_kw = dict(policy=policy, tp_rules=TRANSFORMER_TP_RULES, fsdp=is_fsdp)
+    if cfg.train.dry_init:
+        return _dry_init(job, init_variables, optimizer, mesh, rng, **state_kw)
     state, sharding = create_train_state(
-        lambda r: {"params": model.init_params(r)},
-        optimizer, mesh, rng,
-        policy=policy,
-        tp_rules=TRANSFORMER_TP_RULES,
-        fsdp=is_fsdp,
+        init_variables, optimizer, mesh, rng, **state_kw
     )
     if pipe > 1 and is_fsdp and mesh.shape["model"] == 1:
         # per-layer gather inside the tick: params stay fsdp-sharded.
@@ -583,9 +606,12 @@ def train_cifar_model(cfg: Config, job: str = "cifar_ddp") -> TrainResult:
         cfg.optimization.grad_clip_norm, **_opt_kwargs(cfg, batches),
     )
     rng = jax.random.key(cfg.train.seed)
+    state_kw = dict(policy=policy, fsdp=mesh.shape["fsdp"] > 1)
+    if cfg.train.dry_init:
+        return _dry_init(job, lambda r: model.init_variables(r), optimizer,
+                         mesh, rng, **state_kw)
     state, sharding = create_train_state(
-        lambda r: model.init_variables(r), optimizer, mesh, rng, policy=policy,
-        fsdp=mesh.shape["fsdp"] > 1,
+        lambda r: model.init_variables(r), optimizer, mesh, rng, **state_kw
     )
 
     def loss_fn(params, batch_stats, batch, rngs):
@@ -736,9 +762,11 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
         optimizer = adamw
 
     policy = "bf16_full" if llcfg.compute_dtype == jnp.bfloat16 else "fp32"
+    state_kw = dict(policy=policy, tp_rules=TRANSFORMER_TP_RULES, fsdp=True)
+    if cfg.train.dry_init:
+        return _dry_init(job, init_variables, optimizer, mesh, rng, **state_kw)
     state, sharding = create_train_state(
-        init_variables, optimizer, mesh, rng, policy=policy,
-        tp_rules=TRANSFORMER_TP_RULES, fsdp=True,
+        init_variables, optimizer, mesh, rng, **state_kw
     )
     # Real weights, if present on disk, replace the random init *after*
     # the jitted init (loading inside the traced fn would bake the 7B

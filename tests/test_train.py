@@ -299,3 +299,46 @@ class TestPipelineTraining:
         cfg.distributed.pipe = 4
         res = train_language_model(cfg, "language_fsdp")
         assert np.isfinite(res.final_loss)
+
+
+class TestDryInit:
+    """--dry-init / plan_train_state: the eval_shape-only memory plan
+    must account bytes correctly and never touch device memory (it is
+    how the 7B config is validated on boxes without a chip)."""
+
+    def test_plan_matches_real_state(self, mesh8):
+        import optax
+
+        from hyperion_tpu.models.llama import Llama, llama_tiny_config
+        from hyperion_tpu.train.state import plan_train_state
+
+        model = Llama(llama_tiny_config())
+        shapes, sharding, plan = plan_train_state(
+            lambda r: {"params": model.init_params(r)},
+            optax.adamw(1e-4), mesh8, jax.random.key(0),
+            policy="bf16_full", fsdp=True,
+        )
+        n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes.params))
+        assert plan["param_count"] == n > 0
+        # bf16_full stores params in bf16: bytes = 2 * count
+        assert plan["params_gb"] == round(2 * n / 1e9, 4)
+        # adamw keeps two moments per param (plus scalar counts)
+        assert plan["opt_state_gb"] >= plan["params_gb"] * 1.9
+        assert plan["total_gb"] > 0
+        # fsdp over the mesh: per-device strictly below the global total
+        if mesh8.shape["fsdp"] > 1:
+            assert plan["per_device_gb"] < plan["total_gb"]
+
+    def test_cli_dry_init_runs_no_training(self, tmp_path, capsys):
+        from hyperion_tpu.cli import main as cli
+
+        cli.main([
+            "--model", "llama", "--llama_size", "tiny", "--lora",
+            "--epochs", "1", "--batch_size", "8", "--no-validate",
+            "--dry-init", "--base_dir", str(tmp_path),
+        ])
+        out = capsys.readouterr().out
+        assert "dry-init memory plan" in out
+        assert "param_count" in out
+        # no metrics CSV was written: nothing trained
+        assert not list((tmp_path / "distributed").glob("*_metrics.csv"))
