@@ -12,7 +12,22 @@ PROBE_SLEEP="${PROBE_SLEEP:-540}"
 DEADLINE="${DEADLINE:-$(($(date +%s) + ${WATCH_HOURS:-11} * 3600))}"
 export JAX_PLATFORMS=""
 
+busy() {
+  # Host-busy interlock: a capture fired while pytest or a CPU-mesh
+  # dryrun hogs this box's single core measures contention, not the
+  # chip (81.7 vs 175.75 TFLOPS on the identical chain, 2026-07-31).
+  # Heavy jobs `touch results/.host_busy` and remove it when done; a
+  # stale flag (>45 min) is ignored in case a job died without cleanup.
+  local f=results/.host_busy
+  [ -f "$f" ] && [ $(( $(date +%s) - $(stat -c %Y "$f") )) -lt 2700 ]
+}
+
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if busy; then
+    echo "[watch] host busy (results/.host_busy); deferring probe 120s"
+    sleep 120
+    continue
+  fi
   if timeout 90 python -c "
 import jax
 d = jax.devices()[0]
