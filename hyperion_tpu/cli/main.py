@@ -44,7 +44,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--export-merged", action="store_true",
                    help="LoRA runs: also export base+adapters merged so "
                         "infer.generate can load the fine-tune directly")
-    p.add_argument("--llama_size", choices=["tiny", "7b"], default="7b")
+    p.add_argument("--llama_size", choices=["tiny", "7b", "70b"], default="7b")
     p.add_argument("--steps-per-epoch", type=int, default=0,
                    help="cap steps per epoch (0 = full pass)")
     p.add_argument("--precision", choices=["fp32", "bf16", "bf16_full"],
@@ -138,12 +138,12 @@ def make_config(args, job: str) -> Config:
     cfg.train.seed = args.seed
     cfg.train.lora = args.lora
     cfg.train.export_merged = args.export_merged
-    cfg.train.model = "llama_tiny" if args.llama_size == "tiny" else "llama_7b"
+    cfg.train.model = f"llama_{args.llama_size}" if job == "llama" else cfg.train.model
     cfg.optimization.precision = args.precision
     cfg.optimization.grad_accum_steps = args.grad_accum
-    # 7B llama doesn't fit un-rematerialized on one chip; tiny llama and
+    # 7B/70B llama don't fit un-rematerialized on one chip; tiny llama and
     # every other job default to no remat. An explicit --remat always wins.
-    needs_remat = job == "llama" and args.llama_size == "7b"
+    needs_remat = job == "llama" and args.llama_size in ("7b", "70b")
     cfg.optimization.remat = args.remat or ("full" if needs_remat else "none")
     cfg.optimization.compile_tier = args.compile_tier
     cfg.optimization.attention_impl = args.attention_impl

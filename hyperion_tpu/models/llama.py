@@ -80,6 +80,20 @@ def llama2_7b_config(**kw) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
+def llama2_70b_config(**kw) -> LlamaConfig:
+    """70B-shaped: the GQA geometry (64 query heads sharing 8 KV heads —
+    the attention stack's `rep = n_heads // n_kv_heads` path at its
+    intended ratio, and an 8x smaller KV cache at decode). Too big for
+    any single chip; pairs with `--dry-init --mesh ...` to plan pod-
+    scale FSDP/TP layouts from any box."""
+    base = dict(
+        d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+        ff_dim=28672,
+    )
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
 def llama_tiny_config(**kw) -> LlamaConfig:
     """Test/bench-sized config with the real op mix."""
     base = dict(

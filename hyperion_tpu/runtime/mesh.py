@@ -110,6 +110,20 @@ def make_mesh(
     return Mesh(arr, AxisName.ALL, axis_types=auto)
 
 
+def make_abstract_mesh(spec: MeshSpec) -> jax.sharding.AbstractMesh:
+    """Shape-only mesh for planning (`--dry-init`): no devices are
+    touched — `jax.devices()` is never called, so it works with a dead
+    backend — and axis sizes may exceed the local device count (plan a
+    64-chip pod layout from a laptop). Every axis must be explicit:
+    there is no device count to infer ``-1`` from."""
+    if -1 in spec.shape:
+        raise ValueError(
+            f"abstract mesh needs explicit axis sizes (no -1): {spec}"
+        )
+    auto = (jax.sharding.AxisType.Auto,) * len(AxisName.ALL)
+    return jax.sharding.AbstractMesh(spec.shape, AxisName.ALL, axis_types=auto)
+
+
 # --- active mesh -------------------------------------------------------
 # Model code is deliberately mesh-agnostic, but the sequence-parallel
 # attention impls (ring/ulysses) are shard_maps that need the Mesh

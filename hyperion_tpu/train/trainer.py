@@ -35,7 +35,13 @@ from hyperion_tpu.data.sharding import ShardedBatches
 from hyperion_tpu.data.text import load_wikitext2
 from hyperion_tpu.data.vision import load_cifar10
 from hyperion_tpu.metrics.csv_logger import SCHEMAS, CsvLogger
-from hyperion_tpu.models.llama import Llama, llama2_7b_config, llama_tiny_config, load_hf_checkpoint
+from hyperion_tpu.models.llama import (
+    Llama,
+    llama2_7b_config,
+    llama2_70b_config,
+    llama_tiny_config,
+    load_hf_checkpoint,
+)
 from hyperion_tpu.models.lora import (
     LoraConfig,
     apply_lora,
@@ -285,14 +291,22 @@ def _model_impls(tier_impl: dict) -> dict:
 
 
 def _build_mesh(cfg: Config):
+    from hyperion_tpu.runtime.mesh import make_abstract_mesh, set_active_mesh
+
+    spec = cfg.distributed.mesh_spec()
+    if cfg.train.dry_init and -1 not in spec.shape:
+        # plan-only with an explicit mesh: an AbstractMesh of ANY size —
+        # jax.devices() is never called, so a 64-chip layout plans fine
+        # from a chipless box (or with the TPU tunnel dead)
+        mesh = make_abstract_mesh(spec)
+        set_active_mesh(mesh)
+        return mesh
     devices = None
     if cfg.distributed.max_devices:
         devices = jax.devices()[: cfg.distributed.max_devices]
-    mesh = make_mesh(cfg.distributed.mesh_spec(), devices=devices)
+    mesh = make_mesh(spec, devices=devices)
     # register the TRAINING mesh for the mesh-dependent attention impls
     # (ring/ulysses); side meshes built elsewhere never rebind it
-    from hyperion_tpu.runtime.mesh import set_active_mesh
-
     set_active_mesh(mesh)
     return mesh
 
@@ -349,7 +363,7 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
     conventional mesh (ddp → data axis, fsdp → fsdp axis)."""
     dist.setup()
     mesh = _build_mesh(cfg)
-    n_dev = mesh.devices.size
+    n_dev = mesh.size
     is_fsdp = job == "language_fsdp" or mesh.shape["fsdp"] > 1
 
     want = ("train", "validation") if cfg.train.validate else ("train",)
@@ -591,7 +605,7 @@ def train_cifar_model(cfg: Config, job: str = "cifar_ddp") -> TrainResult:
     global-view sums."""
     dist.setup()
     mesh = _build_mesh(cfg)
-    n_dev = mesh.devices.size
+    n_dev = mesh.size
 
     splits = load_cifar10(cfg.train.base_dir, seed=cfg.train.seed)
     batches = ShardedBatches(
@@ -696,26 +710,23 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
 
     dist.setup()
     mesh = _build_mesh(cfg)
-    n_dev = mesh.devices.size
+    n_dev = mesh.size
 
     tier_impl = _tier_impls(cfg)
     # the remat flag threads verbatim — '--remat none' must really mean
     # no remat so the baseline is measurable (the CLI defaults llama to
     # 'full' since 7B doesn't fit un-rematerialized on a single chip)
-    llcfg = (
-        llama_tiny_config(
-            # the tiny config's default 64-token context must stretch to
-            # the data's window or RoPE runs out of table rows
-            max_len=max(cfg.train.seq_len, 64),
-            remat=cfg.optimization.remat,
-            **_model_impls(tier_impl),
-        )
-        if cfg.train.model == "llama_tiny"
-        else llama2_7b_config(
-            max_len=max(cfg.train.seq_len, 128),
-            remat=cfg.optimization.remat,
-            **_model_impls(tier_impl),
-        )
+    size_configs = {
+        "llama_tiny": llama_tiny_config,
+        "llama_7b": llama2_7b_config,
+        "llama_70b": llama2_70b_config,
+    }
+    llcfg = size_configs[cfg.train.model](
+        # the tiny config's default 64-token context must stretch to the
+        # data's window or RoPE runs out of table rows
+        max_len=max(cfg.train.seq_len, 128 if cfg.train.model != "llama_tiny" else 64),
+        remat=cfg.optimization.remat,
+        **_model_impls(tier_impl),
     )
     model = Llama(llcfg)
     mode = "lora_bf16" if cfg.train.lora else "fsdp_bf16"

@@ -166,6 +166,49 @@ def training_table(runs: Path) -> None:
     print()
 
 
+def attention_table(root: Path) -> None:
+    """Long-seq attention scaling (no reference counterpart — it never
+    runs attention past seq 128): xla vs pallas flash per seq length."""
+    rows = _read(root / "attention" / "attention_scaling.csv")
+    if not rows:
+        print("(attention/attention_scaling.csv not captured yet)\n")
+        return
+    by_key = {(r["seq"], r["mode"], r["impl"]): r for r in rows}
+    seqs = sorted({int(r["seq"]) for r in rows})
+    print("| Seq | Mode | XLA ms | Flash ms | Speedup | XLA temp GB | "
+          "Flash temp GB |")
+    print("|---|---|---|---|---|---|---|")
+    for seq in seqs:
+        for mode in ("fwd", "train"):
+            xla = by_key.get((str(seq), mode, "xla"))
+            pl = by_key.get((str(seq), mode, "pallas"))
+            if xla is None and pl is None:
+                continue
+
+            def cell(r, k):
+                if r is None:
+                    return "—"
+                if r.get("status") != "ok":
+                    return r.get("status", "—")
+                return r.get(k, "—")
+
+            speedup = "—"
+            # only when BOTH rows measured: float("nan") parses fine, so
+            # an oom row would otherwise render as "nanx"
+            if xla and pl and xla.get("status") == "ok" and pl.get("status") == "ok":
+                try:
+                    speedup = (
+                        f"{float(xla['per_iter_ms']) / float(pl['per_iter_ms']):.2f}x"
+                    )
+                except (KeyError, TypeError, ValueError, ZeroDivisionError):
+                    pass
+            print(f"| {seq} | {mode} | {cell(xla, 'per_iter_ms')} | "
+                  f"{cell(pl, 'per_iter_ms')} | {speedup} | "
+                  f"{cell(xla, 'temp_memory_gb')} | "
+                  f"{cell(pl, 'temp_memory_gb')} |")
+    print()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--root", default="results/benchmarks")
@@ -180,6 +223,8 @@ def main() -> None:
     scaling_table(root)
     print("## Compile tiers (C14)\n")
     compile_table(root)
+    print("## Long-seq attention (beyond reference)\n")
+    attention_table(root)
     print("## Training runs\n")
     training_table(Path(args.runs))
 

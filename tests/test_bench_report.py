@@ -263,3 +263,24 @@ class TestAttentionBench:
         _write_csv(tmp_path / "mixed.csv", [ok, bad])
         rows = list(csv.DictReader((tmp_path / "mixed.csv").open()))
         assert rows[0]["note"] == "" and rows[1]["status"] == "error"
+
+    def test_attention_table_renders(self, tmp_path, capsys):
+        # uses the report-runner helper from TestCompareToReference (the
+        # table lives in the same compare_to_reference.py report)
+        adir = tmp_path / "benchmarks" / "attention"
+        adir.mkdir(parents=True)
+        with (adir / "attention_scaling.csv").open("w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=[
+                "seq", "impl", "mode", "status", "per_iter_ms",
+                "temp_memory_gb"])
+            w.writeheader()
+            w.writerow({"seq": 8192, "impl": "xla", "mode": "train",
+                        "status": "oom", "per_iter_ms": "nan",
+                        "temp_memory_gb": "nan"})
+            w.writerow({"seq": 8192, "impl": "pallas", "mode": "train",
+                        "status": "ok", "per_iter_ms": 12.5,
+                        "temp_memory_gb": 0.21})
+        out = TestCompareToReference()._run(tmp_path, capsys)
+        assert "Long-seq attention" in out
+        assert "oom" in out and "12.5" in out  # xla OOM row renders as such
+        assert "nanx" not in out  # no speedup computed from a nan row

@@ -342,3 +342,16 @@ class TestDryInit:
         assert "param_count" in out
         # no metrics CSV was written: nothing trained
         assert not list((tmp_path / "distributed").glob("*_metrics.csv"))
+
+    def test_abstract_mesh_plans_beyond_local_devices(self, tmp_path, capsys):
+        from hyperion_tpu.cli import main as cli
+
+        # fsdp=16 exceeds the 8 simulated CPU devices: planning must use
+        # an AbstractMesh and never ask the backend for devices
+        cli.main([
+            "--model", "llama", "--llama_size", "tiny", "--lora",
+            "--epochs", "1", "--batch_size", "16", "--no-validate",
+            "--dry-init", "--mesh", "1,16,1,1", "--base_dir", str(tmp_path),
+        ])
+        out = capsys.readouterr().out
+        assert '"fsdp": 16' in out and "dry-init memory plan" in out
