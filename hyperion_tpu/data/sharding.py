@@ -78,13 +78,18 @@ class ShardedBatches:
             )
         self.steps_per_epoch = self.n // global_batch
 
-    def epoch(self, epoch: int) -> Iterator[dict[str, jax.Array]]:
+    def epoch(
+        self, epoch: int, start_step: int = 0
+    ) -> Iterator[dict[str, jax.Array]]:
         """One pass over the data; `epoch` feeds the permutation seed
-        (the sampler.set_epoch analogue)."""
+        (the sampler.set_epoch analogue). `start_step` resumes mid-epoch
+        after a preemption: the SAME seeded permutation, minus the
+        already-trained prefix — skipped batches are never materialized
+        on device."""
         order = np.arange(self.n)
         if self.shuffle:
             np.random.default_rng((self.seed, epoch)).shuffle(order)
-        for s in range(self.steps_per_epoch):
+        for s in range(start_step, self.steps_per_epoch):
             idx = order[s * self.global_batch : (s + 1) * self.global_batch]
             yield {
                 k: self._make_global(v, idx) for k, v in self.arrays.items()

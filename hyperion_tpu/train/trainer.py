@@ -63,6 +63,7 @@ from hyperion_tpu.train.state import (
 )
 from hyperion_tpu.train.step import make_eval_step, make_train_step
 from hyperion_tpu.utils import profiling
+from hyperion_tpu.utils.preemption import PreemptionGuard
 from hyperion_tpu.utils.timing import host_fence
 
 
@@ -142,6 +143,20 @@ def _sum_of(metric_stack: list[dict], key: str) -> float:
     return float(jnp.sum(jnp.stack([m[key] for m in metric_stack])))
 
 
+def _save_checkpoint(ckpt_dir: str, state, tag: str) -> None:
+    """Barrier-fenced sharded save + prune — the ONE implementation for
+    both the epoch-boundary and preemption paths. Named host barriers
+    fence the IO the way the reference bracketed FSDP checkpointing
+    (distributed_utils.py:369,405) — and fail fast if a peer died.
+    Checkpoint IO duration legitimately skews across hosts (slow shared
+    storage), so the timeout is generous — the reference raised its
+    watchdog to 7200 s around exactly this IO."""
+    dist.host_barrier(f"pre_ckpt_{tag}", timeout_s=3600.0)
+    ckpt.save(ckpt_dir, state, force=True)
+    ckpt.prune(ckpt_dir, keep=2)  # full sharded state per epoch adds up
+    dist.host_barrier(f"post_ckpt_{tag}", timeout_s=3600.0)
+
+
 def _epoch_loop(
     *,
     job: str,
@@ -155,10 +170,17 @@ def _epoch_loop(
     extra_cols: Callable[[list], dict] | None = None,
     ckpt_dir: str | None = None,
     resume_epoch: int = 0,
+    resume_step: int = 0,
     eval_step=None,
     eval_batches: ShardedBatches | None = None,
     eval_cols: Callable[[list], dict] | None = None,
-) -> tuple[Any, list[EpochRecord]]:
+    guard: PreemptionGuard | None = None,
+) -> tuple[Any, list[EpochRecord], bool]:
+    """Returns (state, history, preempted). `preempted=True` means the
+    run stopped early on a signal — callers must then skip final exports
+    (a half-trained tree must not clobber a previous `*_final.npz`, and
+    gathering 7B params inside a ~30 s preemption grace window invites a
+    SIGKILL mid-write)."""
     history: list[EpochRecord] = []
     # The simulated-CPU backend's in-process collectives deadlock when the
     # async dispatch queue runs deep (every virtual device shares one
@@ -166,77 +188,128 @@ def _epoch_loop(
     # queue stays deep — that pipelining is where async dispatch wins.
     fence_every_step = jax.default_backend() == "cpu"
     max_steps = cfg.train.steps_per_epoch or None
-    for epoch in range(resume_epoch, cfg.train.epochs):
-        # --profile-dir: capture a jax.profiler trace of the FIRST epoch
-        # this run executes (SURVEY §5.1's idiomatic upgrade)
-        profile_this = cfg.train.profile_dir and epoch == resume_epoch
-        with profiling.capture(
-            cfg.train.profile_dir if profile_this else None
-        ):
-            t0 = time.perf_counter()
-            device_metrics = []
-            for i, batch in enumerate(batches.epoch(epoch)):
-                if max_steps and i >= max_steps:
-                    break
-                state, metrics = train_step(state, batch, rng)
-                device_metrics.append(metrics)  # stays on device until epoch end
-                if fence_every_step:
-                    jax.block_until_ready(metrics)
-            # host-fetch fence: on the axon backend block_until_ready can
-            # return before execution, so fetch a scalar of the last
-            # step's metrics (which depends, through the state chain, on
-            # every step of the epoch) before stopping the timer — and
-            # before the profiler capture closes, so traces are complete
-            host_fence(device_metrics[-1])
-            duration = time.perf_counter() - t0  # train-only; val follows
-        loss = _mean_of(device_metrics, "loss")
-        extra = extra_cols(device_metrics) if extra_cols else {}
-        if eval_step is not None and eval_batches is not None:
-            # validation pass (exceeds the reference, which never
-            # evaluated): deterministic order, no dropout, no grads
-            val_metrics = []
-            for i, vbatch in enumerate(eval_batches.epoch(0)):
-                if max_steps and i >= max_steps:
-                    break
-                val_metrics.append(eval_step(state, vbatch))
-            if val_metrics:
-                host_fence(val_metrics[-1])
-            # eval_cols must handle an empty list (a val split smaller
-            # than one global batch yields zero batches): the schema
-            # already promises the columns, so NaNs beat a missing-column
-            # crash at the end of epoch 1
-            extra.update(
-                eval_cols(val_metrics) if eval_cols
-                else {"val_loss": _mean_of(val_metrics, "loss")
-                      if val_metrics else float("nan")}
+    guard = guard if guard is not None else PreemptionGuard()
+    n_proc = dist.process_count()
+
+    def stop_requested() -> bool:
+        # Single-process (every single-host run, and this repo's bench
+        # environment): the local latch IS the decision, zero overhead.
+        # Multi-host: the signal can land on different hosts at different
+        # step boundaries; acting on a local flag would desynchronize the
+        # loops — one host breaks while its peers sit in a cross-host
+        # collective, and the "synchronized" checkpoint would mix
+        # optimizer steps. All hosts therefore agree via an allgather at
+        # each boundary (every host calls it the same number of times,
+        # so the collective stays aligned). This costs one tiny host-
+        # synced collective per step in multi-host runs only — the price
+        # of a checkpoint that is guaranteed step-consistent.
+        if n_proc == 1:
+            return guard.triggered
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(np.int32(guard.triggered))
+        return bool(np.asarray(flags).max())
+
+    with guard:
+        for epoch in range(resume_epoch, cfg.train.epochs):
+            # mid-epoch resume after a preemption: only the interrupted
+            # epoch skips its already-trained prefix
+            start = resume_step if epoch == resume_epoch else 0
+            stopping = False
+            # --profile-dir: capture a jax.profiler trace of the FIRST
+            # epoch this run executes (SURVEY §5.1's idiomatic upgrade)
+            profile_this = cfg.train.profile_dir and epoch == resume_epoch
+            with profiling.capture(
+                cfg.train.profile_dir if profile_this else None
+            ):
+                t0 = time.perf_counter()
+                device_metrics = []
+                for i, batch in enumerate(batches.epoch(epoch, start), start):
+                    if max_steps and i >= max_steps:
+                        break
+                    # stop check BEFORE the step: a signal that lands
+                    # during validation/checkpoint IO must not burn one
+                    # more training step on the way out
+                    if stop_requested():
+                        stopping = True
+                        break
+                    state, metrics = train_step(state, batch, rng)
+                    device_metrics.append(metrics)  # on device until epoch end
+                    if fence_every_step:
+                        jax.block_until_ready(metrics)
+                # host-fetch fence: on the axon backend block_until_ready
+                # can return before execution, so fetch a scalar of the
+                # last step's metrics (which depends, through the state
+                # chain, on every step of the epoch) before stopping the
+                # timer — and before the profiler capture closes, so
+                # traces are complete
+                if device_metrics:
+                    host_fence(device_metrics[-1])
+                duration = time.perf_counter() - t0  # train-only
+            planned = _steps_per_epoch(cfg, batches) - start
+            if stopping and len(device_metrics) < planned:
+                # cut short mid-epoch: the state holds every COMPLETED
+                # step; save and exit cleanly. The next run's _prepare_run
+                # resumes this epoch at its next batch, so the partial
+                # epoch is finished (and logged) there — no partial row
+                # pollutes the CSV. (A signal arriving AFTER the last
+                # step instead falls through: the finished epoch gets its
+                # row, validation, and epoch-boundary save first.)
+                if ckpt_dir:
+                    _save_checkpoint(ckpt_dir, state, f"preempt_{epoch}")
+                if dist.is_primary():
+                    print(f"[{job}] preempted at global step {int(state.step)} "
+                          f"(epoch {epoch + 1}); "
+                          + ("checkpoint saved — rerun to resume mid-epoch"
+                             if ckpt_dir else "no checkpoint dir — state lost"))
+                return state, history, True
+            loss = _mean_of(device_metrics, "loss")
+            extra = extra_cols(device_metrics) if extra_cols else {}
+            if eval_step is not None and eval_batches is not None:
+                # validation pass (exceeds the reference, which never
+                # evaluated): deterministic order, no dropout, no grads
+                val_metrics = []
+                for i, vbatch in enumerate(eval_batches.epoch(0)):
+                    if max_steps and i >= max_steps:
+                        break
+                    val_metrics.append(eval_step(state, vbatch))
+                if val_metrics:
+                    host_fence(val_metrics[-1])
+                # eval_cols must handle an empty list (a val split smaller
+                # than one global batch yields zero batches): the schema
+                # already promises the columns, so NaNs beat a missing-column
+                # crash at the end of epoch 1
+                extra.update(
+                    eval_cols(val_metrics) if eval_cols
+                    else {"val_loss": _mean_of(val_metrics, "loss")
+                          if val_metrics else float("nan")}
+                )
+            row = EpochRecord(epoch + 1, loss, duration, extra)
+            history.append(row)
+            logger.log(
+                epoch=row.epoch, loss=row.loss, duration_s=row.duration_s,
+                gpus=n_devices, **extra,
             )
-        row = EpochRecord(epoch + 1, loss, duration, extra)
-        history.append(row)
-        logger.log(
-            epoch=row.epoch, loss=row.loss, duration_s=row.duration_s,
-            gpus=n_devices, **extra,
-        )
-        if dist.is_primary():
-            extras = "".join(
-                f" {k}={v:.4f}" if isinstance(v, float) else f" {k}={v}"
-                for k, v in extra.items()
-            )
-            print(
-                f"[{job}] epoch {row.epoch}/{cfg.train.epochs} "
-                f"loss={loss:.4f}{extras} ({duration:.2f}s)"
-            )
-        if ckpt_dir:
-            # named host barriers fence the IO the way the reference
-            # bracketed FSDP checkpointing (distributed_utils.py:369,405)
-            # — and fail fast if a peer died mid-epoch. Checkpoint IO
-            # duration legitimately skews across hosts (slow shared
-            # storage), so the timeout is generous — the reference
-            # raised its watchdog to 7200 s around exactly this IO.
-            dist.host_barrier(f"pre_ckpt_{epoch}", timeout_s=3600.0)
-            ckpt.save(ckpt_dir, state, force=True)
-            ckpt.prune(ckpt_dir, keep=2)  # full sharded state per epoch adds up
-            dist.host_barrier(f"post_ckpt_{epoch}", timeout_s=3600.0)
-    return state, history
+            if dist.is_primary():
+                extras = "".join(
+                    f" {k}={v:.4f}" if isinstance(v, float) else f" {k}={v}"
+                    for k, v in extra.items()
+                )
+                print(
+                    f"[{job}] epoch {row.epoch}/{cfg.train.epochs} "
+                    f"loss={loss:.4f}{extras} ({duration:.2f}s)"
+                )
+            if ckpt_dir:
+                _save_checkpoint(ckpt_dir, state, str(epoch))
+            if stopping:
+                # signal arrived at the epoch's end: the epoch is fully
+                # trained, logged, and saved above — stop before starting
+                # the next one. Resume continues at the next epoch.
+                if dist.is_primary():
+                    print(f"[{job}] preempted at epoch boundary "
+                          f"{epoch + 1}/{cfg.train.epochs}; rerun to resume")
+                return state, history, True
+    return state, history, False
 
 
 def _lm_eval_cols(vm: list) -> dict:
@@ -326,7 +399,7 @@ def _tree_tag(mesh, cfg: Config) -> str:
 def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int,
                  extra_schema: tuple = (), tree_tag: str = ""):
     """CSV logger + checkpoint-restore/resume bookkeeping shared by every
-    trainer. Returns (logger, ckpt_dir, state, resume_epoch).
+    trainer. Returns (logger, ckpt_dir, state, resume_epoch, resume_step).
     `extra_schema` appends columns (e.g. val metrics) after the
     reference-compatible base columns; `tree_tag` namespaces checkpoint
     dirs per param-tree variant (`_tree_tag`)."""
@@ -347,13 +420,19 @@ def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int,
             f"dataset of {batches.n} examples (drop_last semantics)"
         )
     restored = ckpt.restore(ckpt_dir, state)
-    resume_epoch = 0
+    resume_epoch, resume_step = 0, 0
     if restored is not None:
         state = restored
+        # step-level resume: a preemption checkpoint lands mid-epoch, so
+        # the interrupted epoch continues from its next un-trained batch
+        # (same seeded permutation — no batch trained twice or skipped)
         resume_epoch = int(state.step) // steps_per_epoch
+        resume_step = int(state.step) % steps_per_epoch
         if dist.is_primary():
-            print(f"[{job}] resumed from step {int(state.step)} (epoch {resume_epoch})")
-    return logger, ckpt_dir, state, resume_epoch
+            at = f" step {resume_step}" if resume_step else ""
+            print(f"[{job}] resumed from step {int(state.step)} "
+                  f"(epoch {resume_epoch}{at})")
+    return logger, ckpt_dir, state, resume_epoch, resume_step
 
 
 def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
@@ -580,21 +659,24 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
         extra_schema = ("lm_loss", "aux_loss") + tuple(extra_schema)
 
     tree_tag = _tree_tag(mesh, cfg)
-    logger, ckpt_dir, state, resume_epoch = _prepare_run(
+    logger, ckpt_dir, state, resume_epoch, resume_step = _prepare_run(
         job, cfg, state, batches, n_dev, extra_schema, tree_tag
     )
-    state, history = _epoch_loop(
+    state, history, preempted = _epoch_loop(
         job=job, cfg=cfg, batches=batches, state=state, train_step=train_step,
         rng=rng, logger=logger, n_devices=n_dev, ckpt_dir=ckpt_dir,
-        resume_epoch=resume_epoch, extra_cols=extra_cols,
+        resume_epoch=resume_epoch, resume_step=resume_step, extra_cols=extra_cols,
         eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
     )
-    # the final export is namespaced per param tree too: a pipe/MoE run
-    # must not clobber the dense export the generation CLI points at
-    ckpt.export_gathered(
-        f"{cfg.train.base_dir}/checkpoints/{job}{tree_tag}_final.npz",
-        state.params,
-    )
+    if not preempted:
+        # the final export is namespaced per param tree too: a pipe/MoE
+        # run must not clobber the dense export the generation CLI points
+        # at. Skipped on preemption: a half-trained tree must not
+        # overwrite a previous final export.
+        ckpt.export_gathered(
+            f"{cfg.train.base_dir}/checkpoints/{job}{tree_tag}_final.npz",
+            state.params,
+        )
     return TrainResult(job, logger.run, str(logger.path), ckpt_dir, history)
 
 
@@ -678,18 +760,19 @@ def train_cifar_model(cfg: Config, job: str = "cifar_ddp") -> TrainResult:
 
         extra_schema = ("val_loss", "val_accuracy")
 
-    logger, ckpt_dir, state, resume_epoch = _prepare_run(
+    logger, ckpt_dir, state, resume_epoch, resume_step = _prepare_run(
         job, cfg, state, batches, n_dev, extra_schema
     )
-    state, history = _epoch_loop(
+    state, history, preempted = _epoch_loop(
         job=job, cfg=cfg, batches=batches, state=state, train_step=train_step,
         rng=rng, logger=logger, n_devices=n_dev, extra_cols=accuracy_cols,
-        ckpt_dir=ckpt_dir, resume_epoch=resume_epoch,
+        ckpt_dir=ckpt_dir, resume_epoch=resume_epoch, resume_step=resume_step,
         eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
     )
-    ckpt.export_gathered(
-        f"{cfg.train.base_dir}/checkpoints/{job}_final.npz", state.params
-    )
+    if not preempted:  # never clobber a final export with half an epoch
+        ckpt.export_gathered(
+            f"{cfg.train.base_dir}/checkpoints/{job}_final.npz", state.params
+        )
     return TrainResult(job, logger.run, str(logger.path), ckpt_dir, history)
 
 
@@ -826,17 +909,17 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
         cfg, splits, mesh, sharding, loss_fn, transform=clamped
     )
 
-    logger, ckpt_dir, state, resume_epoch = _prepare_run(
+    logger, ckpt_dir, state, resume_epoch, resume_step = _prepare_run(
         job, cfg, state, batches, n_dev, extra_schema
     )
-    state, history = _epoch_loop(
+    state, history, preempted = _epoch_loop(
         job=job, cfg=cfg, batches=batches, state=state, train_step=train_step,
         rng=rng, logger=logger, n_devices=n_dev,
         extra_cols=lambda _: {"mode": mode},
-        ckpt_dir=ckpt_dir, resume_epoch=resume_epoch,
+        ckpt_dir=ckpt_dir, resume_epoch=resume_epoch, resume_step=resume_step,
         eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
     )
-    if dist.is_primary() and history:
+    if dist.is_primary() and history and not preempted:
         # committed evidence row for "the 7B path at size": step time,
         # tokens/s, peak HBM — the numbers BASELINE.md's Llama row is
         # judged against (reference: 4123 s/epoch bs1 on one MI250X).
