@@ -147,6 +147,10 @@ stage 3600 train_cifar python -m hyperion_tpu.cli.main \
   --model cifar --epochs 50 --base_dir "$RUNS"
 commit "Real-chip capture: cifar_ddp 50-epoch training run" "$RUNS"
 
+stage 2400 train_language_fsdp python -m hyperion_tpu.cli.main \
+  --model language_fsdp --epochs 10 --base_dir "$RUNS"
+commit "Real-chip capture: language_fsdp 10-epoch training run" "$RUNS"
+
 # 7. Llama-2-7B at size, random-init, LoRA + full remat, bs1 (VERDICT
 #    item 3). Two epochs so the summary's best-epoch throughput row
 #    excludes compile; the trainer writes *_summary.json with
