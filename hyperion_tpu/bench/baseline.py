@@ -28,6 +28,7 @@ import json
 from pathlib import Path
 from typing import Callable
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -68,6 +69,47 @@ def _vit_spec(batch: int, dtype: str):
     return variables, apply, (x, y)
 
 
+class _RefFallbackCNN(nn.Module):
+    """The reference's ACTUAL "ViT" benchmark subject.
+
+    `baseline_performance.ipynb cell 0:35-54`: on the reference's
+    torchvision build, `create_vit_model` falls back to a ~100K-param
+    Sequential CNN (conv7x7/2 -> maxpool -> conv3x3 -> maxpool -> GAP
+    -> linear 128->1000), and the committed `model_benchmarks.csv` row
+    2 (5.44 ms / 515 MB / 5883 samples/s at bs 32) is consistent with
+    that CNN, not with an 86M-param ViT-B/16 (which could not train
+    ~10x faster than the same GPU's ResNet-50). Benchmarked here
+    verbatim so the comparison table has an apples-to-apples row; the
+    real ViT-B/16 row stands on its own with no true reference
+    counterpart.
+    """
+
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x):
+        dt = jnp.dtype(self.dtype)
+        x = x.astype(dt)
+        x = nn.relu(nn.Conv(64, (7, 7), strides=2, padding=3, dtype=dt)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        x = nn.relu(nn.Conv(128, (3, 3), padding=1, dtype=dt)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        x = jnp.mean(x, axis=(1, 2))  # AdaptiveAvgPool2d((1,1)) + Flatten
+        return nn.Dense(1000, dtype=dt)(x).astype(jnp.float32)
+
+
+def _vit_fallback_cnn_spec(batch: int, dtype: str):
+    model = _RefFallbackCNN(dtype=dtype)
+    x = jnp.zeros((batch, 224, 224, 3), jnp.float32)
+    y = jnp.zeros((batch,), jnp.int32)
+    variables = {"params": model.init({"params": jax.random.key(0)}, x)["params"]}
+
+    def apply(params, batch_stats, x):
+        return model.apply({"params": params}, x)
+
+    return variables, apply, (x, y)
+
+
 def _custom_transformer_spec(batch: int, dtype: str, seq: int = 16):
     model = TransformerEncoder(custom_transformer_config(dropout=0.0, dtype=dtype))
     variables = {"params": model.init_params(jax.random.key(0), seq=seq)}
@@ -83,13 +125,14 @@ def _custom_transformer_spec(batch: int, dtype: str, seq: int = 16):
 MODEL_SPECS: dict[str, Callable] = {
     "resnet50": _resnet50_spec,
     "vit_b16": _vit_spec,
+    "vit_fallback_cnn": _vit_fallback_cnn_spec,
     "custom_transformer": _custom_transformer_spec,
 }
 
 
 def benchmark_model(
     name: str, batch: int, dtype: str = "bfloat16",
-    iters: int = 20, warmup: int = 5,
+    iters: int = 20, warmup: int = 5, static_memory: bool = True,
 ) -> dict:
     """One row of the reference's `model_benchmarks.csv`."""
     variables, apply, (x, y) = MODEL_SPECS[name](batch, dtype)
@@ -143,6 +186,30 @@ def benchmark_model(
     opt_ms = max(t_full.per_iter_ms - t_bwd.per_iter_ms, 0.0)
 
     peak = peak_bytes_in_use()
+    mem_source = "allocator_peak"
+    if peak == 0 and not static_memory:
+        mem_source = "unavailable"
+    elif peak == 0:
+        # backends without allocator counters (e.g. the axon tunnel):
+        # fall back to XLA's static analysis of the full-step program —
+        # live bytes = arguments (params/opt state/batch) + temps +
+        # un-aliased outputs, the same quantity the reference's
+        # max_memory_allocated approximates per step
+        try:
+            ma = (
+                jax.jit(full_step)
+                .lower(params, opt_state, batch_stats, x, y)
+                .compile()
+                .memory_analysis()
+            )
+            peak = int(
+                ma.argument_size_in_bytes
+                + ma.temp_size_in_bytes
+                + ma.output_size_in_bytes
+            )
+            mem_source = "xla_static"
+        except Exception:  # noqa: BLE001 — analysis unavailable
+            mem_source = "unavailable"
     return {
         "model": name,
         "batch_size": batch,
@@ -152,6 +219,7 @@ def benchmark_model(
         "optimizer_ms": round(opt_ms, 3),
         "total_ms": round(t_full.per_iter_ms, 3),
         "peak_memory_mb": round(peak / 1e6, 2),
+        "memory_source": mem_source,
         "samples_per_s": round(t_full.throughput(batch), 2),
         "dispatch_overhead_ms": round(t_full.overhead_ms, 2),
     }
@@ -166,7 +234,12 @@ def batch_size_scaling(
     rows = []
     for bs in batch_sizes:
         try:
-            rows.append(benchmark_model(name, bs, dtype, iters=iters, warmup=3))
+            # static_memory=False: the fallback memory analysis costs a
+            # fresh full-step compile per row — across a 7-bs sweep on a
+            # cold tunnel that risks the capture stage's time limit, and
+            # the scaling comparison only consumes samples/s
+            rows.append(benchmark_model(name, bs, dtype, iters=iters, warmup=3,
+                                        static_memory=False))
         except Exception as e:  # noqa: BLE001 — XLA OOM ends the sweep
             msg = str(e).splitlines()[0][:120]
             print(f"[baseline] {name} bs={bs}: stopping sweep ({msg})")

@@ -20,10 +20,18 @@ import json
 from pathlib import Path
 
 # model -> (total_ms, peak_mb, samples_per_s) at batch 32, from
-# BASELINE.md / model_benchmarks.csv
+# BASELINE.md / model_benchmarks.csv.
+#
+# The reference's "create_vit_model" row is NOT a ViT: its builder falls
+# back to a ~100K-param Sequential CNN on the reference's torchvision
+# build (`baseline_performance.ipynb cell 0:35-54`), and the committed
+# 5.44 ms / 515 MB row matches that CNN (an 86M-param ViT-B/16 cannot
+# train 10x faster than the same GPU's ResNet-50). So the apples-to-
+# apples peer of that row is our `vit_fallback_cnn` replica; the real
+# `vit_b16` row has no true reference counterpart.
 REF_MODELS = {
     "resnet50": (56.32, 3230.98, 568.22),
-    "vit_b16": (5.44, 514.87, 5883.44),
+    "vit_fallback_cnn": (5.44, 514.87, 5883.44),
     "custom_transformer": (12.52, 617.17, 2555.90),
 }
 # bs -> samples_per_s, ResNet-50 batch scaling (create_resnet50_batch_scaling.csv)
@@ -53,14 +61,22 @@ def model_table(root: Path) -> None:
     print("|---|---|---|---|---|---|---|")
     for r in rows:
         name = r["model"]
-        if name not in REF_MODELS or int(r["batch_size"]) != 32:
+        try:  # a stage killed mid-write leaves a truncated last row
+            if int(r["batch_size"]) != 32:
+                continue
+            if r.get("dtype") not in (None, "", "bfloat16"):
+                continue
+            ms, sps = float(r["total_ms"]), float(r["samples_per_s"])
+        except (TypeError, ValueError):
             continue
-        if r.get("dtype") not in (None, "", "bfloat16"):
-            continue
-        ref_ms, _, ref_sps = REF_MODELS[name]
-        ms, sps = float(r["total_ms"]), float(r["samples_per_s"])
-        print(f"| {name} | {ref_ms} | {ms:.2f} | {ref_ms / ms:.2f}x | "
-              f"{ref_sps} | {sps:.1f} | {sps / ref_sps:.2f}x |")
+        if name in REF_MODELS:
+            ref_ms, _, ref_sps = REF_MODELS[name]
+            print(f"| {name} | {ref_ms} | {ms:.2f} | {ref_ms / ms:.2f}x | "
+                  f"{ref_sps} | {sps:.1f} | {sps / ref_sps:.2f}x |")
+        elif name == "vit_b16":
+            # real ViT-B/16 — reference's "vit" row is its fallback CNN
+            print(f"| {name} (no true ref: ref row is a fallback CNN) | - | "
+                  f"{ms:.2f} | - | - | {sps:.1f} | - |")
     print()
 
 
