@@ -20,7 +20,8 @@ Design (classic flash attention, TPU-shaped):
   * Causal programs skip kv tiles past the diagonal (`pl.when`) and
     mask the in-tile diagonal with broadcasted iotas — the standard
     ~2x FLOP saving.
-  * Padding masks ([B, T], 1 = real) ride in as (1, block_kv) tiles.
+  * Padding masks ([B, T], 1 = real) ride in as int32
+    (SUBLANES, block_kv) tiles whose sublane rows are replicas.
 
   * Backward: the standard two-pass recomputation. A host-side
     `delta = sum(dO * O, -1)` (one fused XLA reduction), then two
@@ -40,6 +41,26 @@ same caveat as every standard flash implementation.
 
 On non-TPU backends the kernels run in interpret mode so the full test
 suite exercises them on the simulated CPU mesh.
+
+TPU lowering note: Mosaic requires the last two dims of every physical
+block to be (8, 128)-divisible or equal to the array dims
+(`jax/_src/pallas/mosaic/lowering.py` `lower_jaxpr_to_module`). The
+batch/head grid dims therefore use mapped (`None`) BlockSpec entries —
+squeezed out of the kernel refs — and the per-row lse/delta tensors
+carry a trailing LANES=128 broadcast dim at the kernel boundary
+([B, H, T, 128], value replicated across lanes), because a [B, H, T]
+row tensor admits no legal block: its second-to-last array dim is H,
+and a (…, 1, block_q) block's 1 neither divides 8 nor equals H. The
+lane replication (rather than a (1, block_q) lane-major layout) keeps
+each stat sublane-aligned with its logits-tile row, so the kernels
+slice [:, :1] with no relayout — the same layout
+`jax.experimental.pallas.ops.tpu.flash_attention` uses for its l/m
+stats. Only lane 0 is information: the VJP residual stores the compact
+[B, H, T] slice, and `_flash_backward` re-broadcasts both lse and
+delta transiently (so long-sequence configs don't hold 128x-replicated
+fp32 stats across the fwd/bwd boundary). The padding mask rides as
+int32 (not int8): a rank-1 int8 block needs 512-element tiling, int32
+needs 128.
 """
 
 from __future__ import annotations
@@ -56,6 +77,19 @@ from hyperion_tpu.ops.attention import NEG_INF
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_KV = 128
+LANES = 128     # lane-broadcast width for per-row stats (lse/delta)
+SUBLANES = 8    # sublane-broadcast height for the padding mask
+
+
+def _mask_arg(padding_mask):
+    """[B, Tkv] mask → [B, SUBLANES, Tkv] int32: a [B, Tkv] array admits
+    no legal TPU block (B sits in the second-to-last dim), so replicate
+    rows across a sublane dim — the same trick jax's TPU flash kernel
+    uses for kv segment ids."""
+    B, Tkv = padding_mask.shape
+    return jnp.broadcast_to(
+        padding_mask.astype(jnp.int32)[:, None, :], (B, SUBLANES, Tkv)
+    )
 
 
 def _interpret() -> bool:
@@ -82,7 +116,7 @@ def _tile_mask(s, qi, ki, block_q, block_kv, causal, pad_ref):
         )
         mask = kv_pos <= q_pos
     if pad_ref is not None:
-        pad = pad_ref[0] > 0  # (block_kv,)
+        pad = pad_ref[0] > 0  # (block_kv,) — sublane rows are replicas
         pad = jnp.broadcast_to(pad[None, :], s.shape)
         mask = pad if mask is None else jnp.logical_and(mask, pad)
     if mask is None:
@@ -121,9 +155,9 @@ def _fwd_kernel(
 
     @pl.when(relevant)
     def _update():
-        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (block_q, D)
-        k = k_ref[0, 0].astype(jnp.float32)             # (block_kv, D)
-        v = v_ref[0, 0].astype(jnp.float32)
+        q = q_ref[...].astype(jnp.float32) * sm_scale  # (block_q, D)
+        k = k_ref[...].astype(jnp.float32)             # (block_kv, D)
+        v = v_ref[...].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -149,8 +183,10 @@ def _fwd_kernel(
     @pl.when(ki == last_ki)
     def _finalize():
         l = jnp.maximum(l_s[...], 1e-30)
-        o_ref[0, 0] = (acc_s[...] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_s[...] + jnp.log(l)
+        o_ref[...] = (acc_s[...] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[...] = jnp.broadcast_to(
+            (m_s[...] + jnp.log(l))[:, None], lse_ref.shape
+        )
 
 
 def _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv):
@@ -170,15 +206,23 @@ def _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv):
     n_q, n_kv = Tq // block_q, Tkv // block_kv
 
     grid = (B, H, n_q, n_kv)
-    qspec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
-    kvspec = pl.BlockSpec((1, 1, block_kv, D), lambda b, h, i, j: (b, h, j, 0))
+    # batch/head dims are mapped (None) so the physical blocks are the
+    # Mosaic-legal (block_q, D) / (block_q,) shapes — see module note
+    qspec = pl.BlockSpec(
+        (None, None, block_q, D), lambda b, h, i, j: (b, h, i, 0)
+    )
+    kvspec = pl.BlockSpec(
+        (None, None, block_kv, D), lambda b, h, i, j: (b, h, j, 0)
+    )
     in_specs = [qspec, kvspec, kvspec]
     args = [qT, kT, vT]
     if padding_mask is not None:
         in_specs.append(
-            pl.BlockSpec((1, block_kv), lambda b, h, i, j: (b, j))
+            pl.BlockSpec(
+                (None, SUBLANES, block_kv), lambda b, h, i, j: (b, 0, j)
+            )
         )
-        args.append(padding_mask.astype(jnp.int8))
+        args.append(_mask_arg(padding_mask))
 
     kernel = functools.partial(
         _fwd_kernel,
@@ -195,11 +239,13 @@ def _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv):
         in_specs=in_specs,
         out_specs=[
             qspec,
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec(
+                (None, None, block_q, LANES), lambda b, h, i, j: (b, h, i, 0)
+            ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(qT.shape, q.dtype),
-            jax.ShapeDtypeStruct((B, H, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Tq, LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q,), jnp.float32),
@@ -239,24 +285,24 @@ def _dq_kernel(
 
     @pl.when(relevant)
     def _update():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]       # (block_q,)
-        delta = dl_ref[0, 0]      # (block_q,)
+        q = q_ref[...].astype(jnp.float32)
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][:, :1]    # (block_q, 1) — lane-broadcast stats
+        delta = dl_ref[...][:, :1]   # (block_q, 1)
 
         s = sm_scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         s = _tile_mask(s, qi, ki, block_q, block_kv, causal, pad_ref)
-        p = jnp.exp(s - lse[:, None])                      # exact softmax
+        p = jnp.exp(s - lse)                               # exact softmax
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         dq_s[...] = dq_s[...] + sm_scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -269,7 +315,7 @@ def _dq_kernel(
 
     @pl.when(ki == last_ki)
     def _finalize():
-        dq_ref[0, 0] = dq_s[...].astype(dq_ref.dtype)
+        dq_ref[...] = dq_s[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
@@ -301,19 +347,19 @@ def _dkv_kernel(
 
     @pl.when(relevant)
     def _update():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = dl_ref[0, 0]
+        q = q_ref[...].astype(jnp.float32)
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][:, :1]    # (block_q, 1)
+        delta = dl_ref[...][:, :1]
 
         s = sm_scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_q, block_kv)
         s = _tile_mask(s, qi, ki, block_q, block_kv, causal, pad_ref)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         # dv += p^T do
         dv_s[...] = dv_s[...] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -323,7 +369,7 @@ def _dkv_kernel(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         # dk += scale * ds^T q
         dk_s[...] = dk_s[...] + sm_scale * jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -332,8 +378,8 @@ def _dkv_kernel(
 
     @pl.when(qi == n_q - 1)
     def _finalize():
-        dk_ref[0, 0] = dk_s[...].astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv_s[...].astype(dv_ref.dtype)
+        dk_ref[...] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_s[...].astype(dv_ref.dtype)
 
 
 def _flash_backward(
@@ -345,10 +391,15 @@ def _flash_backward(
     block_kv = min(block_kv, Tkv)
     n_q, n_kv = Tq // block_q, Tkv // block_kv
 
-    # delta_i = sum_d dO_id * O_id — one fused XLA reduction, [B, H, Tq]
-    delta = jnp.sum(
-        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-    ).transpose(0, 2, 1)
+    # lse arrives compact [B, H, Tq] (the residual keeps only lane 0);
+    # delta_i = sum_d dO_id * O_id is one fused XLA reduction. Both are
+    # lane-broadcast to the kernels' [B, H, Tq, LANES] row-stat layout.
+    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, LANES))
+    delta = jnp.broadcast_to(
+        jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+        .transpose(0, 2, 1)[..., None],
+        lse.shape,
+    )
 
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)
@@ -356,19 +407,27 @@ def _flash_backward(
     gT = g.transpose(0, 2, 1, 3)
 
     sm_scale = 1.0 / (D ** 0.5)
-    qspec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
-    kvspec_dq = pl.BlockSpec(
-        (1, 1, block_kv, D), lambda b, h, i, j: (b, h, j, 0)
+    qspec = pl.BlockSpec(
+        (None, None, block_q, D), lambda b, h, i, j: (b, h, i, 0)
     )
-    rowspec = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i))
+    kvspec_dq = pl.BlockSpec(
+        (None, None, block_kv, D), lambda b, h, i, j: (b, h, j, 0)
+    )
+    rowspec = pl.BlockSpec(
+        (None, None, block_q, LANES), lambda b, h, i, j: (b, h, i, 0)
+    )
+
+    mask_arg = None if padding_mask is None else _mask_arg(padding_mask)
 
     dq_in_specs = [qspec, kvspec_dq, kvspec_dq, qspec, rowspec, rowspec]
     dq_args = [qT, kT, vT, gT, lse, delta]
-    if padding_mask is not None:
+    if mask_arg is not None:
         dq_in_specs.append(
-            pl.BlockSpec((1, block_kv), lambda b, h, i, j: (b, j))
+            pl.BlockSpec(
+                (None, SUBLANES, block_kv), lambda b, h, i, j: (b, 0, j)
+            )
         )
-        dq_args.append(padding_mask.astype(jnp.int8))
+        dq_args.append(mask_arg)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -385,19 +444,25 @@ def _flash_backward(
     )(*dq_args)
 
     # transposed sweep: kv tiles outer, q tiles inner
-    qspec_t = pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0))
-    kvspec_t = pl.BlockSpec(
-        (1, 1, block_kv, D), lambda b, h, j, i: (b, h, j, 0)
+    qspec_t = pl.BlockSpec(
+        (None, None, block_q, D), lambda b, h, j, i: (b, h, i, 0)
     )
-    rowspec_t = pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, h, i))
+    kvspec_t = pl.BlockSpec(
+        (None, None, block_kv, D), lambda b, h, j, i: (b, h, j, 0)
+    )
+    rowspec_t = pl.BlockSpec(
+        (None, None, block_q, LANES), lambda b, h, j, i: (b, h, i, 0)
+    )
 
     dkv_in_specs = [qspec_t, kvspec_t, kvspec_t, qspec_t, rowspec_t, rowspec_t]
     dkv_args = [qT, kT, vT, gT, lse, delta]
-    if padding_mask is not None:
+    if mask_arg is not None:
         dkv_in_specs.append(
-            pl.BlockSpec((1, block_kv), lambda b, h, j, i: (b, j))
+            pl.BlockSpec(
+                (None, SUBLANES, block_kv), lambda b, h, j, i: (b, 0, j)
+            )
         )
-        dkv_args.append(padding_mask.astype(jnp.int8))
+        dkv_args.append(mask_arg)
 
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -446,7 +511,8 @@ def flash_attention(
 
 def _fwd(causal, block_q, block_kv, q, k, v, padding_mask):
     out, lse = _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv)
-    return out, (q, k, v, padding_mask, out, lse)
+    # keep only lane 0 of the [B, H, Tq, LANES] stats as the residual
+    return out, (q, k, v, padding_mask, out, lse[..., 0])
 
 
 def _bwd(causal, block_q, block_kv, residuals, g):
