@@ -130,14 +130,21 @@ def _tile_mask(s, qi, ki, block_q, block_kv, causal, pad_ref):
 def _fwd_kernel(
     *refs, causal: bool, sm_scale: float,
     block_q: int, block_kv: int, n_kv: int,
+    has_pad: bool, has_lse: bool,
 ):
-    # positional refs: inputs (q, k, v[, pad]), outputs (o, lse),
-    # scratch (m, l, acc)
-    if len(refs) == 9:
-        q_ref, k_ref, v_ref, pad_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
-        pad_ref = None
+    # positional refs: inputs (q, k, v[, pad]), outputs (o[, lse]),
+    # scratch (m, l, acc). lse is only emitted when the VJP will
+    # consume it — the inference path skips the [B, H, Tq, LANES]
+    # HBM write entirely.
+    q_ref, k_ref, v_ref = refs[:3]
+    i = 3
+    pad_ref = refs[i] if has_pad else None
+    i += int(has_pad)
+    o_ref = refs[i]
+    i += 1
+    lse_ref = refs[i] if has_lse else None
+    i += int(has_lse)
+    m_s, l_s, acc_s = refs[i:]
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -184,12 +191,15 @@ def _fwd_kernel(
     def _finalize():
         l = jnp.maximum(l_s[...], 1e-30)
         o_ref[...] = (acc_s[...] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[...] = jnp.broadcast_to(
-            (m_s[...] + jnp.log(l))[:, None], lse_ref.shape
-        )
+        if lse_ref is not None:
+            lse_ref[...] = jnp.broadcast_to(
+                (m_s[...] + jnp.log(l))[:, None], lse_ref.shape
+            )
 
 
-def _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv):
+def _flash_forward(
+    q, k, v, padding_mask, causal, block_q, block_kv, need_lse=True
+):
     B, Tq, H, D = q.shape
     Tkv = k.shape[1]
     block_q = min(block_q, Tq)
@@ -231,22 +241,26 @@ def _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv):
         block_q=block_q,
         block_kv=block_kv,
         n_kv=n_kv,
+        has_pad=padding_mask is not None,
+        has_lse=need_lse,
     )
 
-    o, lse = pl.pallas_call(
+    out_specs = [qspec]
+    out_shape = [jax.ShapeDtypeStruct(qT.shape, q.dtype)]
+    if need_lse:
+        out_specs.append(pl.BlockSpec(
+            (None, None, block_q, LANES), lambda b, h, i, j: (b, h, i, 0)
+        ))
+        out_shape.append(
+            jax.ShapeDtypeStruct((B, H, Tq, LANES), jnp.float32)
+        )
+
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=[
-            qspec,
-            pl.BlockSpec(
-                (None, None, block_q, LANES), lambda b, h, i, j: (b, h, i, 0)
-            ),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(qT.shape, q.dtype),
-            jax.ShapeDtypeStruct((B, H, Tq, LANES), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
@@ -255,6 +269,7 @@ def _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv):
         compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(*args)
+    o, lse = res if need_lse else (res[0], None)
     return o.transpose(0, 2, 1, 3), lse
 
 
@@ -496,7 +511,9 @@ def _flash_backward(
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
 def _flash(causal, block_q, block_kv, q, k, v, padding_mask):
-    out, _ = _flash_forward(q, k, v, padding_mask, causal, block_q, block_kv)
+    out, _ = _flash_forward(
+        q, k, v, padding_mask, causal, block_q, block_kv, need_lse=False
+    )
     return out
 
 
