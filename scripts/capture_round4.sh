@@ -115,7 +115,7 @@ commit "Real-chip capture: headline bench (bf16 matmul + LM step)" "$OUT"
 #    precision comparison for ResNet-50 / ViT-B16 / CustomTransformer
 #    (C17 — closes the component marked partial for lack of a real-chip
 #    CSV). Rows flush incrementally, so even a timeout commits evidence.
-stage 3000 baseline python -m hyperion_tpu.bench.baseline --scaling \
+stage 6000 baseline python -m hyperion_tpu.bench.baseline --scaling \
   --precisions float32 bfloat16 --out "$OUT/baseline"
 commit "Real-chip capture: baseline model benchmarks (C17)" "$OUT"
 

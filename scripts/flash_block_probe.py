@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Flash-attention block-size probe — pick DEFAULT_BLOCK_Q/KV on real HW.
+
+Round-4 finding (`results/benchmarks/attention/attention_scaling.csv`):
+the Pallas kernel measured 3.3-7 TFLOPS vs XLA's ~15 at the GPT-2 head
+geometry. Two suspects: fp32-cast matmuls (fixed in the kernel — input
+dtype now drives the MXU) and 128x128 tiles too small to amortize per-
+grid-step overhead at D=64. This probe sweeps (block_q, block_kv) on
+the real chip for fwd and train steps at a long sequence and prints one
+JSON row per variant, so the kernel defaults can be set from
+measurement instead of guesses.
+
+Run (real chip): python scripts/flash_block_probe.py [--seq 4096]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+
+import jax
+import jax.numpy as jnp
+
+from hyperion_tpu.ops.pallas.flash_attention import flash_attention
+from hyperion_tpu.utils.timing import time_chained
+
+BATCH, HEADS, HEAD_DIM = 1, 12, 64  # the attention_bench geometry
+
+
+def _attn_flops(seq: int, backward: bool) -> float:
+    fwd = 2 * 2 * BATCH * HEADS * seq * seq * HEAD_DIM * 0.5
+    return fwd * 3.5 if backward else fwd
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", type=int, default=4096)
+    p.add_argument("--blocks", type=int, nargs="*",
+                   default=[128, 256, 512, 1024])
+    p.add_argument("--modes", nargs="*", default=["fwd", "train"])
+    args = p.parse_args()
+
+    ks = jax.random.split(jax.random.key(0), 3)
+    shape = (BATCH, args.seq, HEADS, HEAD_DIM)
+    q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) / 2 for kk in ks)
+
+    for mode, (bq, bkv) in itertools.product(
+        args.modes, itertools.product(args.blocks, repeat=2)
+    ):
+        def fwd_step(q, k, v):
+            o = flash_attention(q, k, v, causal=True, block_q=bq,
+                                block_kv=bkv)
+            return o, k, v
+
+        def train_step(q, k, v):
+            def loss(q, k, v):
+                o = flash_attention(q, k, v, causal=True, block_q=bq,
+                                    block_kv=bkv)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            eps = jnp.asarray(1e-30, q.dtype)
+            return (q - eps * dq.astype(q.dtype),
+                    k - eps * dk.astype(k.dtype),
+                    v - eps * dv.astype(v.dtype))
+
+        step = fwd_step if mode == "fwd" else train_step
+        row = {"seq": args.seq, "mode": mode, "block_q": bq, "block_kv": bkv}
+        try:
+            res = time_chained(step, q, k, v, k1=4, k2=12, n_thread=3)
+            tflops = (_attn_flops(args.seq, mode == "train")
+                      / (res.per_iter_ms / 1e3) / 1e12)
+            row.update(status="ok",
+                       per_iter_ms=round(res.per_iter_ms, 3),
+                       achieved_tflops=round(tflops, 2))
+        except Exception as e:  # noqa: BLE001 — a failing variant is a row
+            row.update(status="error",
+                       note=(str(e).splitlines()[0] if str(e) else repr(e))[:120])
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
