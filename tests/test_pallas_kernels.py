@@ -148,10 +148,14 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=3e-5, rtol=3e-5)
 
-    def test_indivisible_block_raises(self):
+    def test_indivisible_block_falls_back(self):
+        # seq 48 doesn't divide the requested 32: _pick_block falls back
+        # to a legal tiling (here one 48-wide tile) instead of raising
         q, k, v = qkv(shape=(1, 48, 2, 8))
-        with pytest.raises(ValueError, match="divide"):
-            flash_attention(q, k, v, block_q=32, block_kv=32)
+        out = flash_attention(q, k, v, block_q=32, block_kv=32)
+        ref = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5, rtol=3e-5)
 
 
 class TestFusedLayerNorm:
