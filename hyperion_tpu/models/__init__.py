@@ -33,9 +33,11 @@ from hyperion_tpu.models.llama import (  # noqa: F401
 )
 from hyperion_tpu.models.lora import (  # noqa: F401
     LoraConfig,
+    LoraDenseGeneral,
     apply_lora,
     init_lora_params,
     merge_lora,
+    structural_merge,
     trainable_fraction,
 )
 from hyperion_tpu.models.pipeline_lm import (  # noqa: F401

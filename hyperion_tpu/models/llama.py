@@ -55,6 +55,15 @@ class LlamaConfig:
     # MXU matmuls); params come from quantize_params_for() on a trained
     # float checkpoint. Inference-only: train float, then quantize.
     quant: str = "none"
+    # Module-level (functional) LoRA: rank > 0 routes the targeted
+    # projections through models.lora.LoraDenseGeneral's activation
+    # side-path — y = x@W + scale*(x@A)@B — instead of the trainer's
+    # weight-delta merge, which at 7B holds ~4 GB of effective-weight
+    # remat residuals (the round-4 OOM). Adapter leaves are supplied by
+    # lora.structural_merge from the standard {"base","lora"} state.
+    lora_rank: int = 0
+    lora_scale: float = 2.0   # alpha/r at the peft defaults (32/16)
+    lora_targets: tuple[str, ...] = ("q_proj", "k_proj", "v_proj", "o_proj")
     # 7B needs remat on any realistic chip; False/"none", True/"full",
     # or a named precision.remat policy ("dots", "dots_no_batch")
     remat: bool | str = True
@@ -107,12 +116,29 @@ def llama_tiny_config(**kw) -> LlamaConfig:
 def _dense_ctor(c: LlamaConfig):
     """Llama's dense layers: bias-free, normal(0.02) init, routed
     through the shared quant dispatch (`precision.quant.make_dense`) so
-    `c.quant == "int8"` swaps in `QuantDenseGeneral` everywhere.
+    `c.quant == "int8"` swaps in `QuantDenseGeneral` everywhere, and
+    through `LoraDenseGeneral` when `c.lora_rank > 0` (the functional
+    LoRA side-path; non-target sites trace as plain dense layers).
     `nn.DenseGeneral(features=int, axis=-1)` is exactly `nn.Dense`
     (same `kernel` leaf name and shape), so checkpoints are unaffected
     by routing everything through one ctor."""
+    import functools
+
     from hyperion_tpu.precision.quant import make_dense
 
+    if c.lora_rank > 0:
+        if c.quant != "none":
+            raise ValueError("LoRA training and int8 inference quant are "
+                             "mutually exclusive (train float, then "
+                             "merge + quantize)")
+        from hyperion_tpu.models.lora import LoraDenseGeneral
+
+        return functools.partial(
+            LoraDenseGeneral, dtype=c.compute_dtype,
+            kernel_init=nn.initializers.normal(0.02), use_bias=False,
+            lora_rank=c.lora_rank, lora_scale=c.lora_scale,
+            lora_targets=tuple(c.lora_targets),
+        )
     return make_dense(
         c, kernel_init=nn.initializers.normal(0.02), use_bias=False,
     )

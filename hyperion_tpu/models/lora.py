@@ -4,18 +4,29 @@ Reference: `distributed_utils.py:463-476` applies `peft.LoraConfig(r=16,
 lora_alpha=32, lora_dropout=0.05, target_modules=[q_proj,k_proj,v_proj,
 o_proj])` + `get_peft_model` to bf16 Llama-2-7B, then wraps in DDP.
 
-TPU-native formulation: **weight-delta**. Instead of rewriting model
-modules to route activations through adapter matmuls (the peft approach —
-module surgery), the adapted weight is materialized functionally per
-step:
+Two formulations, one adapter layout:
 
-    W_eff = W_base + (alpha/r) * A @ B
+1. **Weight-delta** (`apply_lora`): the adapted weight is materialized
+   functionally per step — W_eff = W_base + (alpha/r) * A @ B — inside
+   the loss function, under `stop_gradient` on W_base. Works for any
+   model with no module changes; right for small/mid models and for
+   export (`merge_lora`). Its cost: every targeted effective weight
+   becomes an HLO temp held across fwd/bwd (a remat residual). At
+   Llama-7B that is 32 layers x 4 projections x 32 MB ≈ 4 GB, which is
+   exactly how the round-4 single-chip proof OOM'd (16.79 of 15.75 GB).
 
-inside the loss function, under `stop_gradient` on W_base. The trainable
-pytree is *only* {A, B}; the optimizer — and the optimizer *state*, the
-thing LoRA exists to shrink — never sees base params. XLA fuses the
-rank-r outer product into the surrounding graph; the base stays resident
-in bf16 exactly once. This works for any model with no module changes.
+2. **Activation side-path** (`LoraDenseGeneral` + `structural_merge`):
+   y = x @ W + (alpha/r) * (x @ A) @ B computed inside the dense
+   module — the peft formulation, TPU-shaped: no effective weight ever
+   exists, the extra residual per layer is the rank-r activation
+   [B, T, r] (kilobytes), and the MXU sees two skinny matmuls XLA
+   schedules alongside the main one. This is the 7B-scale path.
+
+In both, the trainable pytree is *only* {A, B}; the optimizer — and the
+optimizer *state*, the thing LoRA exists to shrink — never sees base
+params. The adapter tree layout ({path/kernel: {a, b}}) is identical
+across formulations, so checkpoints, resume, `merge_lora`, and
+`--export-merged` are formulation-agnostic.
 
 Deliberate deviation: peft's `lora_dropout` (dropout on the adapter
 *input* activation) has no analogue in weight-space; it is a
@@ -32,6 +43,7 @@ import dataclasses
 import re
 from typing import Any, Sequence
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -120,6 +132,106 @@ def merge_lora(base_params: Any, lora_params: Any, cfg: LoraConfig) -> Any:
     return jax.tree.map(
         lambda x: x, apply_lora(base_params, lora_params, cfg)
     )
+
+
+def target_module_names(lora_params: Any) -> tuple[str, ...]:
+    """Module names (e.g. 'q_proj') that actually carry adapters, from
+    the adapter tree itself — the single source of truth for a
+    module-level config's `lora_targets`. Deriving (rather than listing
+    twice) prevents the silent divergence where an adapter exists but
+    no module reads it: flax ignores unused param leaves, so a
+    hand-maintained module list that drifts from `LoraConfig.targets`
+    would train fewer sites than `trainable_fraction` reports."""
+    names = set()
+    for path in traverse_util.flatten_dict(lora_params, sep="/"):
+        parts = path.split("/")  # ".../<module>/kernel/{a,b}"
+        if len(parts) >= 3 and parts[-2] == "kernel":
+            names.add(parts[-3])
+    return tuple(sorted(names))
+
+
+def structural_merge(base_params: Any, lora_params: Any) -> Any:
+    """Insert adapter leaves into the model tree for the activation
+    side-path: each `{path}/kernel: {a, b}` adapter becomes
+    `{path}/lora_a` and `{path}/lora_b` siblings of the kernel, where
+    `LoraDenseGeneral` reads them. Pure tree surgery — no arithmetic,
+    no copies; the leaves are re-referenced, not materialized."""
+    flat = dict(traverse_util.flatten_dict(base_params, sep="/"))
+    for path, leaf in traverse_util.flatten_dict(lora_params, sep="/").items():
+        if path.endswith("/kernel/a"):
+            flat[path[: -len("/kernel/a")] + "/lora_a"] = leaf
+        elif path.endswith("/kernel/b"):
+            flat[path[: -len("/kernel/b")] + "/lora_b"] = leaf
+        else:
+            raise ValueError(f"unexpected LoRA adapter leaf {path!r}")
+    return traverse_util.unflatten_dict(flat, sep="/")
+
+
+class LoraDenseGeneral(nn.Module):
+    """Bias-free DenseGeneral with the LoRA activation side-path:
+
+        y = x @ W  +  scale * (x @ A) @ B      (when this site is a
+                                                target and rank > 0)
+
+    Same `kernel` leaf name/shape as `nn.DenseGeneral` (checkpoints are
+    layout-identical), with `lora_a`/`lora_b` siblings matching
+    `init_lora_params`' shapes — `structural_merge` maps the trainer's
+    adapter tree straight onto them. The effective weight W + scale*A@B
+    is never materialized: the weight-delta formulation holds every
+    targeted effective kernel as a remat residual across fwd/bwd
+    (~4 GB at 7B — the round-4 single-chip OOM, 16.79 of 15.75 GB HBM);
+    here the extra residual is the [.., T, r] rank activation.
+
+    Whether the side-path exists is static (rank > 0 and the module
+    name in `targets`), so non-target sites trace identically to a
+    plain dense layer. Gradient flow into W vs (A, B) is the caller's
+    concern: the trainer differentiates only the adapter subtree and
+    stop-gradients the base (train/trainer.py llama path).
+    """
+
+    features: int | tuple[int, ...]
+    axis: int | tuple[int, ...] = -1
+    dtype: Any = jnp.bfloat16
+    kernel_init: Any = jax.nn.initializers.normal(0.02)
+    use_bias: bool = False
+    lora_rank: int = 0
+    lora_scale: float = 1.0
+    lora_targets: tuple[str, ...] = ()
+
+    @nn.compact
+    def __call__(self, x):
+        from hyperion_tpu.precision.quant import normalize_dense_geometry
+
+        if self.use_bias:
+            raise NotImplementedError("LoraDenseGeneral is bias-free")
+        feats, axes, in_shape = normalize_dense_geometry(
+            x, self.features, self.axis
+        )
+        dt = jnp.dtype(self.dtype)
+
+        kernel = self.param(
+            "kernel", self.kernel_init, in_shape + feats, jnp.float32
+        )
+        contract = (axes, tuple(range(len(axes))))
+        xc = x.astype(dt)
+        y = jax.lax.dot_general(
+            xc, kernel.astype(dt), (contract, ((), ()))
+        )
+
+        if self.lora_rank > 0 and self.name in self.lora_targets:
+            a = self.param(
+                "lora_a", jax.nn.initializers.he_uniform(),
+                in_shape + (self.lora_rank,), jnp.float32,
+            )
+            b = self.param(
+                "lora_b", jax.nn.initializers.zeros,
+                (self.lora_rank,) + feats, jnp.float32,
+            )
+            xa = jax.lax.dot_general(
+                xc, a.astype(dt), (contract, ((), ()))
+            )  # [..., r]
+            y = y + self.lora_scale * jnp.tensordot(xa, b.astype(dt), axes=1)
+        return y
 
 
 def count_params(tree: Any) -> int:

@@ -98,6 +98,20 @@ def quantized_dense(
 # --- weight-only int8 as a flax layer (the model-integration path) ------
 
 
+def normalize_dense_geometry(x, features, axis):
+    """Shared DenseGeneral-call geometry: normalize `features`/`axis` to
+    tuples, require trailing contraction axes, derive the kernel's
+    input shape. Used by QuantDenseGeneral and models.lora.
+    LoraDenseGeneral so the two dense variants cannot drift."""
+    feats = (features,) if isinstance(features, int) else tuple(features)
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    axes = tuple(a % x.ndim for a in axes)
+    if axes != tuple(range(x.ndim - len(axes), x.ndim)):
+        raise ValueError(f"contraction axes must be trailing, got {axes}")
+    in_shape = tuple(x.shape[a] for a in axes)
+    return feats, axes, in_shape
+
+
 class QuantDenseGeneral(nn.Module):
     """`nn.DenseGeneral(use_bias=False)` reading an int8 kernel.
 
@@ -123,13 +137,9 @@ class QuantDenseGeneral(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        feats = (self.features,) if isinstance(self.features, int) \
-            else tuple(self.features)
-        axes = (self.axis,) if isinstance(self.axis, int) else tuple(self.axis)
-        axes = tuple(a % x.ndim for a in axes)
-        if axes != tuple(range(x.ndim - len(axes), x.ndim)):
-            raise ValueError(f"contraction axes must be trailing, got {axes}")
-        in_shape = tuple(x.shape[a] for a in axes)
+        feats, axes, in_shape = normalize_dense_geometry(
+            x, self.features, self.axis
+        )
         kshape = in_shape + feats
         kq = self.param("kernel_q", nn.initializers.zeros, kshape, jnp.int8)
         ks = self.param(

@@ -44,9 +44,10 @@ from hyperion_tpu.models.llama import (
 )
 from hyperion_tpu.models.lora import (
     LoraConfig,
-    apply_lora,
     init_lora_params,
     merge_lora,
+    structural_merge,
+    target_module_names,
     trainable_fraction,
 )
 from hyperion_tpu.models.resnet import resnet18
@@ -813,6 +814,7 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
     )
     model = Llama(llcfg)
     mode = "lora_bf16" if cfg.train.lora else "fsdp_bf16"
+    lora_cfg = LoraConfig(rank=cfg.train.lora_rank, alpha=cfg.train.lora_alpha)
 
     want = ("train", "validation") if cfg.train.validate else ("train",)
     splits = load_wikitext2(
@@ -831,7 +833,6 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
         shuffle=True, seed=cfg.train.seed, seq_shard=mesh.shape["seq"] > 1,
     )
 
-    lora_cfg = LoraConfig(rank=cfg.train.lora_rank, alpha=cfg.train.lora_alpha)
     rng = jax.random.key(cfg.train.seed)
 
     def init_variables(r):
@@ -884,12 +885,30 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
         frac = trainable_fraction(state.params["base"], state.params["lora"])
         print(f"[{job}] mode={mode} trainable params: {100 * frac:.3f}% of base")
 
+    # LoRA runs the functional (activation side-path) formulation: a
+    # twin model with lora_rank set reads adapter leaves merged in
+    # structurally — never materializing W + scale*A@B, whose effective-
+    # weight remat residuals OOM'd the 7B proof (models/lora.py). The
+    # base `model` (rank 0) keeps init/checkpoint layouts unchanged, and
+    # the twin's module targets derive from the adapter tree itself so
+    # the two target lists cannot diverge.
+    train_model = (
+        Llama(dataclasses.replace(
+            llcfg, lora_rank=lora_cfg.rank, lora_scale=lora_cfg.scale,
+            lora_targets=target_module_names(state.params["lora"]),
+        )) if cfg.train.lora else model
+    )
+
     def loss_fn(params, batch_stats, batch, rngs):
-        eff = (
-            apply_lora(params["base"], params["lora"], lora_cfg)
-            if cfg.train.lora else params
-        )
-        logits = model.apply(
+        if cfg.train.lora:
+            # adapters-only training: grads must not reach the base
+            # tree (13.5 GB of dW at 7B), and the adapter leaves ride
+            # into the module tree by reference — no weight merge
+            base = jax.tree.map(jax.lax.stop_gradient, params["base"])
+            eff = structural_merge(base, params["lora"])
+        else:
+            eff = params
+        logits = train_model.apply(
             {"params": eff}, batch["input_ids"],
             padding_mask=batch["attention_mask"],
         )

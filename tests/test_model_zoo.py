@@ -152,6 +152,49 @@ class TestLora:
         lora = init_lora_params(jax.random.key(1), base, lcfg)
         return model, base, lora, lcfg
 
+    def test_functional_side_path_equals_weight_delta(self, base_and_lora):
+        """The 7B-scale formulation (LoraDenseGeneral + structural_merge)
+        must be numerically the weight-delta formulation: same forward,
+        same adapter gradients — it only changes WHERE the rank-r term
+        is computed (activation side-path vs materialized W + A@B)."""
+        import dataclasses
+
+        from hyperion_tpu.models.lora import structural_merge
+
+        model, base, lora, lcfg = base_and_lora
+        # nonzero B so the side-path actually contributes
+        lora = jax.tree.map(lambda x: x + 0.05 * jnp.ones_like(x), lora)
+        train_model = Llama(dataclasses.replace(
+            model.cfg, lora_rank=lcfg.rank, lora_scale=lcfg.scale,
+        ))
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(0, model.cfg.vocab_size, (2, 16)),
+            jnp.int32,
+        )
+        y_delta = model.apply({"params": apply_lora(base, lora, lcfg)}, ids)
+        y_func = train_model.apply({"params": structural_merge(base, lora)}, ids)
+        np.testing.assert_allclose(
+            np.asarray(y_delta, np.float32), np.asarray(y_func, np.float32),
+            rtol=1e-4, atol=1e-5,
+        )
+
+        def loss_delta(lo):
+            eff = apply_lora(base, lo, lcfg)
+            return (model.apply({"params": eff}, ids)
+                    .astype(jnp.float32) ** 2).mean()
+
+        def loss_func(lo):
+            b = jax.tree.map(jax.lax.stop_gradient, base)
+            return (train_model.apply({"params": structural_merge(b, lo)}, ids)
+                    .astype(jnp.float32) ** 2).mean()
+
+        g1, g2 = jax.grad(loss_delta)(lora), jax.grad(loss_func)(lora)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+            g1, g2,
+        )
+
     def test_targets_qkvo_only(self, base_and_lora):
         _, base, lora, _ = base_and_lora
         from flax import traverse_util
