@@ -115,7 +115,12 @@ commit "Real-chip capture: headline bench (bf16 matmul + LM step)" "$OUT"
 #    precision comparison for ResNet-50 / ViT-B16 / CustomTransformer
 #    (C17 — closes the component marked partial for lack of a real-chip
 #    CSV). Rows flush incrementally, so even a timeout commits evidence.
+# --batch-sizes capped at 32: the bs-64 ResNet-50 train-step program
+# wedged the axon remote-compile helper twice (>20 min each, no result)
+# and took the tunnel down with it; the reference sweeps to 64 but a
+# 1-32 sweep already shows the scaling shape (RESULTS.md notes the cap)
 stage 6000 baseline python -m hyperion_tpu.bench.baseline --scaling \
+  --batch-sizes 1 2 4 8 16 32 \
   --precisions float32 bfloat16 --out "$OUT/baseline"
 commit "Real-chip capture: baseline model benchmarks (C17)" "$OUT"
 
@@ -126,7 +131,7 @@ stage 2400 compile_bench python -m hyperion_tpu.bench.compile_bench \
 commit "Real-chip capture: compile-tier benchmark (C14)" "$OUT"
 
 # 4. Decode throughput/memory (no reference counterpart; pure headroom).
-stage 1800 decode_bench python -m hyperion_tpu.bench.decode_bench --out "$OUT/decode"
+stage 3600 decode_bench python -m hyperion_tpu.bench.decode_bench --out "$OUT/decode"
 commit "Real-chip capture: decode benchmark" "$OUT"
 
 # 4b. Long-seq attention scaling: XLA vs Pallas flash at 1k-16k (the
