@@ -72,19 +72,23 @@ def benchmark_decode(
     # cache sized to the FULL context: time_chained may auto-grow the
     # chain length for fast models, and every decoded position must stay
     # inside the cache and rope table (growth is capped to match below)
+    # weights ride as jit ARGUMENTS, not closure captures: captured
+    # params are baked into the program as constants (a 3.76 GB
+    # constants warning and multi-minute compiles on the mid/gpt2
+    # models — how the round-4 decode stage blew its time limit)
     prefill = jax.jit(
-        lambda ids: model.apply(
-            variables, ids, cache=init_cache(cfg, batch),
+        lambda v, ids: model.apply(
+            v, ids, cache=init_cache(cfg, batch),
             cache_index=0,
         )
     )
-    t_prefill = time_fn(prefill, ids, warmup=2, iters=5)
-    logits, cache = prefill(ids)
+    t_prefill = time_fn(prefill, variables, ids, warmup=2, iters=5)
+    logits, cache = prefill(variables, ids)
     tok0 = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
 
-    def decode_step(cache, tok, idx):
+    def decode_step(cache, tok, idx, v):
         logits, cache = model.apply(
-            variables, tok[:, None], cache=cache, cache_index=idx
+            v, tok[:, None], cache=cache, cache_index=idx
         )
         nxt = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
         return cache, nxt, idx + 1
@@ -100,7 +104,7 @@ def benchmark_decode(
     k2 = max(2, min(decode_len, budget))
     k1 = max(1, min(k2 - 1, k2 // 3))
     t = time_chained(
-        decode_step, cache, tok0, jnp.int32(prompt_len),
+        decode_step, cache, tok0, jnp.int32(prompt_len), variables,
         k1=k1, k2=k2, n_thread=3, max_k2=budget,
     )
     # Memory, per phase. The PJRT allocator exposes no peak reset, so a
