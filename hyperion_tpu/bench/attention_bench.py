@@ -49,23 +49,30 @@ from hyperion_tpu.bench.util import write_csv
 from hyperion_tpu.ops.attention import dot_product_attention
 from hyperion_tpu.utils.timing import time_chained
 
-# GPT-2-shaped head geometry: the LM family's hot shape.
-BATCH, HEADS, HEAD_DIM = 1, 12, 64
+# (batch, heads, head_dim) per geometry: gpt2 is the toy-LM family's
+# hot shape (D=64 half-fills the MXU contraction); llama is the
+# 7B-family shape (D=128, the MXU's native lane width).
+GEOMETRIES = {
+    "gpt2": (1, 12, 64),
+    "llama": (1, 32, 128),
+}
 
 
-def _qkv(seq: int, dtype: str):
+def _qkv(seq: int, dtype: str, geometry: str):
+    batch, heads, head_dim = GEOMETRIES[geometry]
     ks = jax.random.split(jax.random.key(0), 3)
-    shape = (BATCH, seq, HEADS, HEAD_DIM)
+    shape = (batch, seq, heads, head_dim)
     dt = jnp.dtype(dtype)
-    scale = 1.0 / HEAD_DIM**0.25  # unit-variance logits at any seq
+    scale = 1.0 / head_dim**0.25  # unit-variance logits at any seq
     return tuple(jax.random.normal(k, shape, dt) * scale for k in ks)
 
 
-def _attn_flops(seq: int, backward: bool) -> float:
+def _attn_flops(seq: int, backward: bool, geometry: str) -> float:
     """Causal-aware FLOP count: QK^T and PV are each 2*B*H*T^2*D MACs,
     halved by causality; backward re-does both plus dq/dk/dv (5 matmuls
     vs 2 — the standard 2.5x accounting)."""
-    fwd = 2 * 2 * BATCH * HEADS * seq * seq * HEAD_DIM * 0.5
+    batch, heads, head_dim = GEOMETRIES[geometry]
+    fwd = 2 * 2 * batch * heads * seq * seq * head_dim * 0.5
     return fwd * 3.5 if backward else fwd
 
 
@@ -105,18 +112,21 @@ def _temp_gb(fn, *args) -> float:
 
 def benchmark_attention(
     seq: int, impl: str, mode: str = "train", dtype: str = "bfloat16",
-    k1: int = 4, k2: int = 12,
+    k1: int = 4, k2: int = 12, geometry: str = "gpt2",
 ) -> dict:
     """One row: `mode` is "fwd" (inference shape) or "train" (fwd+bwd)."""
-    q, k, v = _qkv(seq, dtype)
+    batch, heads, head_dim = GEOMETRIES[geometry]
+    q, k, v = _qkv(seq, dtype, geometry)
     step = (_fwd_step if mode == "fwd" else _train_step)(impl)
     row = {
         "seq": seq, "impl": impl, "mode": mode, "dtype": dtype,
-        "batch": BATCH, "heads": HEADS, "head_dim": HEAD_DIM,
+        "geometry": geometry,
+        "batch": batch, "heads": heads, "head_dim": head_dim,
     }
     try:
         res = time_chained(step, q, k, v, k1=k1, k2=k2, n_thread=3)
-        tflops = _attn_flops(seq, mode == "train") / (res.per_iter_ms / 1e3) / 1e12
+        tflops = (_attn_flops(seq, mode == "train", geometry)
+                  / (res.per_iter_ms / 1e3) / 1e12)
         row.update(
             status="ok",
             per_iter_ms=round(res.per_iter_ms, 3),
@@ -142,6 +152,8 @@ def main(argv=None) -> int:
                    default=[1024, 2048, 4096, 8192, 16384])
     p.add_argument("--impls", nargs="*", default=["xla", "pallas"])
     p.add_argument("--modes", nargs="*", default=["fwd", "train"])
+    p.add_argument("--geometries", nargs="*", default=["gpt2", "llama"],
+                   choices=sorted(GEOMETRIES))
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--out", default="results/benchmarks/attention")
     args = p.parse_args(argv)
@@ -152,12 +164,15 @@ def main(argv=None) -> int:
     # bigger T compiles — a capture window that dies mid-sweep still
     # committed a complete like-for-like comparison at every finished T
     for seq in args.seqs:
-        for mode in args.modes:
-            for impl in args.impls:
-                row = benchmark_attention(seq, impl, mode, args.dtype)
-                rows.append(row)
-                write_csv(out / "attention_scaling.csv", rows)
-                print(f"[attention] {json.dumps(row)}")
+        for geometry in args.geometries:
+            for mode in args.modes:
+                for impl in args.impls:
+                    row = benchmark_attention(
+                        seq, impl, mode, args.dtype, geometry=geometry
+                    )
+                    rows.append(row)
+                    write_csv(out / "attention_scaling.csv", rows)
+                    print(f"[attention] {json.dumps(row)}")
     print(f"[attention] results in {out}/")
     # status="oom" is the expected long-seq finding; status="error" means
     # the measurement itself broke (e.g. tunnel death mid-sweep) — exit

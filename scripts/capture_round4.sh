@@ -137,7 +137,11 @@ commit "Real-chip capture: decode benchmark" "$OUT"
 # 4b. Long-seq attention scaling: XLA vs Pallas flash at 1k-16k (the
 #    SURVEY §5.7 long-context evidence; an xla OOM row at 16k is a
 #    finding, not a failure).
-stage 2400 attention_bench python -m hyperion_tpu.bench.attention_bench \
+# 5400s: the sweep now covers two geometries (gpt2 D=64 + llama D=128,
+# ~6x the gpt2-only FLOPs and twice the per-seq compiles); a timeout
+# here restarts the whole sweep on retry (fresh CSV), so the limit
+# errs high rather than looping the stage forever
+stage 5400 attention_bench python -m hyperion_tpu.bench.attention_bench \
   --out "$OUT/attention"
 commit "Real-chip capture: long-seq attention scaling (xla vs pallas flash)" "$OUT"
 

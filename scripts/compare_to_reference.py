@@ -189,39 +189,47 @@ def attention_table(root: Path) -> None:
     if not rows:
         print("(attention/attention_scaling.csv not captured yet)\n")
         return
-    by_key = {(r["seq"], r["mode"], r["impl"]): r for r in rows}
+    # geometry column is absent in pre-r4b captures: default to gpt2
+    geos = sorted({r.get("geometry") or "gpt2" for r in rows})
+    by_key = {
+        (r.get("geometry") or "gpt2", r["seq"], r["mode"], r["impl"]): r
+        for r in rows
+    }
     seqs = sorted({int(r["seq"]) for r in rows})
-    print("| Seq | Mode | XLA ms | Flash ms | Speedup | XLA temp GB | "
-          "Flash temp GB |")
-    print("|---|---|---|---|---|---|---|")
-    for seq in seqs:
-        for mode in ("fwd", "train"):
-            xla = by_key.get((str(seq), mode, "xla"))
-            pl = by_key.get((str(seq), mode, "pallas"))
-            if xla is None and pl is None:
-                continue
+    print("| Geometry | Seq | Mode | XLA ms | Flash ms | Speedup | "
+          "XLA temp GB | Flash temp GB |")
+    print("|---|---|---|---|---|---|---|---|")
+    for geo in geos:
+        for seq in seqs:
+            for mode in ("fwd", "train"):
+                xla = by_key.get((geo, str(seq), mode, "xla"))
+                pl = by_key.get((geo, str(seq), mode, "pallas"))
+                if xla is None and pl is None:
+                    continue
 
-            def cell(r, k):
-                if r is None:
-                    return "—"
-                if r.get("status") != "ok":
-                    return r.get("status", "—")
-                return r.get(k, "—")
+                def cell(r, k):
+                    if r is None:
+                        return "—"
+                    if r.get("status") != "ok":
+                        return r.get("status", "—")
+                    return r.get(k, "—")
 
-            speedup = "—"
-            # only when BOTH rows measured: float("nan") parses fine, so
-            # an oom row would otherwise render as "nanx"
-            if xla and pl and xla.get("status") == "ok" and pl.get("status") == "ok":
-                try:
-                    speedup = (
-                        f"{float(xla['per_iter_ms']) / float(pl['per_iter_ms']):.2f}x"
-                    )
-                except (KeyError, TypeError, ValueError, ZeroDivisionError):
-                    pass
-            print(f"| {seq} | {mode} | {cell(xla, 'per_iter_ms')} | "
-                  f"{cell(pl, 'per_iter_ms')} | {speedup} | "
-                  f"{cell(xla, 'temp_memory_gb')} | "
-                  f"{cell(pl, 'temp_memory_gb')} |")
+                speedup = "—"
+                # only when BOTH rows measured: float("nan") parses
+                # fine, so an oom row would otherwise render as "nanx"
+                if (xla and pl and xla.get("status") == "ok"
+                        and pl.get("status") == "ok"):
+                    try:
+                        speedup = (
+                            f"{float(xla['per_iter_ms']) / float(pl['per_iter_ms']):.2f}x"
+                        )
+                    except (KeyError, TypeError, ValueError, ZeroDivisionError):
+                        pass
+                print(f"| {geo} | {seq} | {mode} | "
+                      f"{cell(xla, 'per_iter_ms')} | "
+                      f"{cell(pl, 'per_iter_ms')} | {speedup} | "
+                      f"{cell(xla, 'temp_memory_gb')} | "
+                      f"{cell(pl, 'temp_memory_gb')} |")
     print()
 
 

@@ -18,31 +18,44 @@ from __future__ import annotations
 import argparse
 import itertools
 import json
+import os
+import sys
 
 import jax
 import jax.numpy as jnp
 
-from hyperion_tpu.ops.pallas.flash_attention import flash_attention
-from hyperion_tpu.utils.timing import time_chained
+# run as `python scripts/flash_block_probe.py`: script dir, not the
+# repo root, is sys.path[0] — add the root so hyperion_tpu imports
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-BATCH, HEADS, HEAD_DIM = 1, 12, 64  # the attention_bench geometry
+from hyperion_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
+from hyperion_tpu.utils.timing import time_chained  # noqa: E402
+
+# Defaults = attention_bench's "gpt2" geometry (D=64, where the 2026-07
+# sweep picked the kernel's 1024x1024 defaults). Pass --heads 32
+# --head-dim 128 for the "llama" geometry — D=128 fills the MXU lane
+# width natively, so the D=64 tuning is a lower bound there, but probe
+# before trusting that.
+BATCH = 1
 
 
-def _attn_flops(seq: int, backward: bool) -> float:
-    fwd = 2 * 2 * BATCH * HEADS * seq * seq * HEAD_DIM * 0.5
+def _attn_flops(seq: int, backward: bool, heads: int, head_dim: int) -> float:
+    fwd = 2 * 2 * BATCH * heads * seq * seq * head_dim * 0.5
     return fwd * 3.5 if backward else fwd
 
 
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--seq", type=int, default=4096)
+    p.add_argument("--heads", type=int, default=12)
+    p.add_argument("--head-dim", type=int, default=64)
     p.add_argument("--blocks", type=int, nargs="*",
                    default=[128, 256, 512, 1024])
     p.add_argument("--modes", nargs="*", default=["fwd", "train"])
     args = p.parse_args()
 
     ks = jax.random.split(jax.random.key(0), 3)
-    shape = (BATCH, args.seq, HEADS, HEAD_DIM)
+    shape = (BATCH, args.seq, args.heads, args.head_dim)
     q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) / 2 for kk in ks)
 
     for mode, (bq, bkv) in itertools.product(
@@ -66,10 +79,12 @@ def main() -> None:
                     v - eps * dv.astype(v.dtype))
 
         step = fwd_step if mode == "fwd" else train_step
-        row = {"seq": args.seq, "mode": mode, "block_q": bq, "block_kv": bkv}
+        row = {"seq": args.seq, "heads": args.heads, "head_dim": args.head_dim,
+               "mode": mode, "block_q": bq, "block_kv": bkv}
         try:
             res = time_chained(step, q, k, v, k1=4, k2=12, n_thread=3)
-            tflops = (_attn_flops(args.seq, mode == "train")
+            tflops = (_attn_flops(args.seq, mode == "train",
+                                  args.heads, args.head_dim)
                       / (res.per_iter_ms / 1e3) / 1e12)
             row.update(status="ok",
                        per_iter_ms=round(res.per_iter_ms, 3),

@@ -245,10 +245,12 @@ class TestAttentionBench:
 
         attention_bench.main([
             "--seqs", "128", "--impls", "xla", "--modes", "fwd",
+            "--geometries", "gpt2",
             "--dtype", "float32", "--out", str(tmp_path)])
         rows = list(csv.DictReader(
             (tmp_path / "attention_scaling.csv").open()))
         assert len(rows) == 1 and rows[0]["status"] == "ok"
+        assert rows[0]["geometry"] == "gpt2"
         assert float(rows[0]["per_iter_ms"]) > 0
         assert float(rows[0]["achieved_tflops"]) > 0
 
