@@ -24,22 +24,37 @@ import numpy as np
 
 from hyperion_tpu.bench.util import write_csv
 from hyperion_tpu.models.llama import Llama, init_cache, llama_tiny_config
-from hyperion_tpu.utils.memory import live_bytes_in_use, peak_bytes_in_use
+from hyperion_tpu.utils.memory import (
+    compiled_peak_bytes,
+    live_bytes_in_use,
+    peak_bytes_in_use,
+)
 from hyperion_tpu.utils.timing import time_chained, time_fn
 
 # "mid" ≈ a 1B-shaped model: big enough that decode is HBM-bound like
-# production decoding, small enough to init on one chip quickly
+# production decoding, small enough to init on one chip quickly.
+# "7b" is the Llama-2-7B geometry (models/llama.py llama_7b_config;
+# reference distributed_utils.py:465-467) at a 1k context so the bf16
+# weights (13.5 GB) + KV cache fit next to decode buffers in 16 GB —
+# the VERDICT r4 item-8 speculative pairing target.
 MODEL_SPECS = {
     "tiny": dict(max_len=512),
     "mid": dict(
         vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
         n_kv_heads=16, ff_dim=5504, max_len=2048, dtype="bfloat16",
     ),
+    "7b": dict(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=32, ff_dim=11008, max_len=1024, dtype="bfloat16",
+    ),
 }
 
 
-def _init_model(name: str):
-    cfg = llama_tiny_config(**MODEL_SPECS[name])  # tiny base + overrides
+def _init_model(name: str, **overrides):
+    """overrides: e.g. vocab_size, so a draft model can share the
+    target's vocab (speculation verifies token ids — mismatched vocabs
+    cannot pair)."""
+    cfg = llama_tiny_config(**{**MODEL_SPECS[name], **overrides})
     model = Llama(cfg)
     params = jax.jit(
         lambda r: model.init_params(r, seq=min(8, cfg.max_len))
@@ -116,6 +131,18 @@ def benchmark_decode(
     # The reference conflated exactly these (memory_allocated vs peak —
     # SURVEY §6 caveats).
     decode_live_mb = live_bytes_in_use() / 1e6
+    peak_mb = peak_bytes_in_use() / 1e6
+    mem_source = "allocator"
+    if not peak_mb:
+        # axon reports no allocator stats (VERDICT r4 weak #3): fall
+        # back to XLA's static analysis of the compiled decode step —
+        # params + cache + step buffers, the steady-state footprint
+        peak_mb = compiled_peak_bytes(
+            jax.jit(decode_step), cache, tok0, jnp.int32(prompt_len),
+            variables,
+        ) / 1e6
+        decode_live_mb = peak_mb
+        mem_source = "xla_memory_analysis"
     return {
         "model": name,
         "mode": "chain",  # dispatch-free chained slope (see module doc)
@@ -127,7 +154,8 @@ def benchmark_decode(
         "decode_tokens_per_s": round(t.throughput(batch), 1),
         "dispatch_overhead_ms": round(t.overhead_ms, 2),
         "decode_live_mb": round(decode_live_mb, 2),
-        "lifetime_peak_mb": round(peak_bytes_in_use() / 1e6, 2),
+        "lifetime_peak_mb": round(peak_mb, 2),
+        "mem_source": mem_source,
         "params_m": round(
             sum(x.size for x in jax.tree.leaves(params)) / 1e6, 1
         ),
@@ -136,6 +164,7 @@ def benchmark_decode(
 
 def benchmark_speculative(
     name: str, prompt_len: int = 128, decode_len: int = 64, k: int = 4,
+    draft: str | None = None,
 ) -> list[dict]:
     """Batch-1 whole-generation wall time: plain greedy vs speculative
     with the target as its own draft (total acceptance). The pair bounds
@@ -146,7 +175,15 @@ def benchmark_speculative(
     Both rows compile the FULL generation into one jit, so — unlike the
     `mode=chain` rows — decode_ms_per_token here INCLUDES prefill and
     one per-call dispatch, amortized over decode_len. Compare gen1 rows
-    only with other gen1 rows."""
+    only with other gen1 rows.
+
+    draft: name of a SMALLER model to pair as a real cross-model draft
+    (VERDICT r4 item 8 — e.g. tiny drafting for 7b). Both are random-
+    init, so greedy agreement — and therefore the acceptance rate — is
+    adversarially bad (~chance); the row measures the machinery's real
+    wall time at that floor. Together with the ceiling row it brackets
+    any trained draft/target pair; the breakeven acceptance rate falls
+    out of (draft_ms, target_ms, k) and lands in the results write-up."""
     from hyperion_tpu.infer.generate import generate
     from hyperion_tpu.infer.speculative import generate_speculative
 
@@ -159,9 +196,43 @@ def benchmark_speculative(
     plain = jax.jit(lambda v, i: generate(model, v, i, decode_len))
     spec = jax.jit(lambda v, i: generate_speculative(
         model, v, model, v, i, decode_len, k=k))
+    variants = [("gen1_plain", plain, variables),
+                ("gen1_spec_ceiling", spec, variables)]
+    if draft:
+        try:
+            # force the draft onto the TARGET's vocab: speculation
+            # verifies token ids, so mismatched vocabs cannot pair
+            # (the stock "tiny" spec carries a 256-token test vocab)
+            dcfg, dmodel, dparams = _init_model(
+                draft, vocab_size=cfg.vocab_size
+            )
+            dvars = {"params": dparams}
+            # generate_speculative signature: TARGET first, draft second
+            spec_draft = jax.jit(lambda v, i: generate_speculative(
+                model, v, dmodel, dvars, i, decode_len, k=k))
+            variants.append(
+                (f"gen1_spec_draft_{draft}", spec_draft, variables)
+            )
+        except Exception as e:  # noqa: BLE001 — a draft-init failure
+            # must not cost the plain/ceiling rows already queued
+            print(f"[decode_bench] draft {draft} setup failed: "
+                  f"{str(e).splitlines()[0][:120]}")
     rows = []
-    for mode, fn in (("gen1_plain", plain), ("gen1_spec_ceiling", spec)):
-        t = time_fn(fn, variables, ids, warmup=1, iters=3)
+    for mode, fn, v in variants:
+        try:
+            t = time_fn(fn, v, ids, warmup=1, iters=3)
+        except Exception as e:  # noqa: BLE001 — one variant's OOM must
+            # not discard the rows already measured this call
+            print(f"[decode_bench] {name}/{mode} failed: "
+                  f"{str(e).splitlines()[0][:120]}")
+            continue
+        peak_mb = peak_bytes_in_use() / 1e6
+        live_mb = live_bytes_in_use() / 1e6
+        mem_source = "allocator"
+        if not peak_mb:
+            peak_mb = compiled_peak_bytes(fn, v, ids) / 1e6
+            live_mb = peak_mb
+            mem_source = "xla_memory_analysis"
         rows.append({
             "model": name, "mode": mode, "quant": "none", "batch": 1,
             "prompt_len": prompt_len,
@@ -169,8 +240,9 @@ def benchmark_speculative(
             "decode_ms_per_token": round(t.median_ms / decode_len, 4),
             "decode_tokens_per_s": round(decode_len / (t.median_ms / 1e3), 1),
             "dispatch_overhead_ms": float("nan"),
-            "decode_live_mb": round(live_bytes_in_use() / 1e6, 2),
-            "lifetime_peak_mb": round(peak_bytes_in_use() / 1e6, 2),
+            "decode_live_mb": round(live_mb, 2),
+            "lifetime_peak_mb": round(peak_mb, 2),
+            "mem_source": mem_source,
             "params_m": round(
                 sum(x.size for x in jax.tree.leaves(params)) / 1e6, 1),
         })
@@ -193,6 +265,10 @@ def main(argv=None) -> None:
                    help="add batch-1 plain vs speculative-ceiling rows "
                         "(whole-generation jit; separate compiles, so "
                         "opt-in)")
+    p.add_argument("--spec-draft", default=None,
+                   choices=sorted(MODEL_SPECS),
+                   help="also measure a real cross-model draft pairing "
+                        "(this model drafts for each --models target)")
     p.add_argument("--no-chain", action="store_true",
                    help="skip the chained per-token rows (e.g. a "
                         "speculative-only capture stage)")
@@ -223,7 +299,8 @@ def main(argv=None) -> None:
         if args.speculative:
             try:
                 rows.extend(benchmark_speculative(
-                    name, args.prompt_len, args.decode_len))
+                    name, args.prompt_len, args.decode_len,
+                    draft=args.spec_draft))
                 flush()
             except Exception as e:  # noqa: BLE001 — per-variant tolerance
                 msg = str(e).splitlines()[0] if str(e) else repr(e)

@@ -37,6 +37,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model", choices=MODELS, default="language_ddp")
     p.add_argument("--epochs", type=int, default=3)
     p.add_argument("--base_dir", default="data")
+    p.add_argument("--data_dir", default="",
+                   help="load corpora from here instead of base_dir "
+                        "(base_dir stays the run-output root — capture "
+                        "runs use --base_dir results/tpu_runs --data_dir "
+                        "data to train on the committed real arrows)")
     p.add_argument("--batch_size", type=int, default=None,
                    help="global batch (defaults per job: LM 32, CIFAR 64, llama 8)")
     p.add_argument("--lora", action="store_true",
@@ -103,10 +108,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="jit+pallas swaps in the in-tree flash-attention "
                         "and fused-norm kernels (max-autotune analogue)")
     p.add_argument("--attention-impl",
-                   choices=["xla", "pallas", "ring", "ulysses"], default=None,
+                   choices=["xla", "pallas", "auto", "ring", "ulysses"],
+                   default=None,
                    help="override just the attention kernel, leaving norms "
-                        "on the tier default; ring/ulysses = sequence "
+                        "on the tier default; auto = geometry-aware "
+                        "pallas/xla crossover; ring/ulysses = sequence "
                         "parallelism over the mesh's seq axis")
+    p.add_argument("--train-split", default="train",
+                   help="corpus split LM jobs optimize on (default train). "
+                        "'test' trains on the REAL WikiText-2 test arrow — "
+                        "the largest real split the reference snapshot "
+                        "ships (its train arrow is absent)")
     return p
 
 
@@ -126,12 +138,14 @@ def make_config(args, job: str) -> Config:
     d = _JOB_DEFAULTS[job]
     cfg.train.epochs = args.epochs
     cfg.train.base_dir = args.base_dir
+    cfg.train.data_dir = args.data_dir
     cfg.train.batch_size = args.batch_size or d["batch_size"]
     cfg.train.learning_rate = args.lr or d["learning_rate"]
     cfg.train.lr_schedule = args.lr_schedule
     cfg.train.warmup_steps = args.warmup_steps
     cfg.train.weight_decay = d.get("weight_decay", 0.0)
     cfg.train.steps_per_epoch = args.steps_per_epoch
+    cfg.train.train_split = args.train_split
     cfg.train.validate = not args.no_validate
     cfg.train.dry_init = args.dry_init
     cfg.train.profile_dir = args.profile_dir

@@ -86,11 +86,24 @@ class TrainConfig:
     warmup_steps: int = 0            # warmup_cosine's linear ramp length
     weight_decay: float = 0.0
     seq_len: int = 128               # reference tokenization window
+    # which corpus split the LM trainers optimize on. The default is the
+    # reference's layout; "test" exists because the reference snapshot
+    # ships REAL WikiText-2 arrows only for validation/test (its train
+    # arrow is absent — /root/reference/data/wikitext2_tokenized/train
+    # holds metadata only), so real-data runs train on the real test
+    # split (the larger: 4358 rows) and validate on the real val split.
+    train_split: str = "train"
     steps_per_epoch: int = 0         # 0 = full pass; >0 caps steps (smoke/bench runs)
     validate: bool = True            # per-epoch val pass (exceeds reference)
     profile_dir: str = ""            # jax.profiler trace of epoch 1 (off when empty)
     seed: int = 0
     base_dir: str = "data"
+    # corpus location override. base_dir doubles as the RUN OUTPUT root
+    # (metrics/checkpoints land under it), so capture runs point it at
+    # results/tpu_runs — which would also move the data search there.
+    # data_dir breaks the tie: when set, datasets load from here while
+    # outputs keep following base_dir. Empty = data under base_dir.
+    data_dir: str = ""
     log_every: int = 50
     lora: bool = False
     lora_rank: int = 16              # reference LoraConfig r=16 α=32 (:470)

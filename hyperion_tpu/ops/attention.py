@@ -10,6 +10,11 @@ is in-tree with selectable implementations:
   impl="pallas"  in-tree flash-attention Pallas kernel
                  (hyperion_tpu.ops.pallas.flash_attention) — the
                  Inductor/Triton "max-autotune" analogue.
+  impl="auto"    geometry-aware choice between the two from the
+                 committed on-chip crossover data (the jit+pallas
+                 tier's default when no explicit impl is configured):
+                 the flash kernel wins long-sequence training, dense
+                 XLA wins short sequences — `select_attention_impl`.
   impl="ring"    sequence-parallel ring attention over the active
   impl="ulysses" mesh's seq axis (ops.ring_attention / ops.ulysses) —
                  a model config string turns on context parallelism.
@@ -27,6 +32,35 @@ import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -2.0 ** 30  # large-but-finite: keeps bf16 softmax NaN-free
+
+# Crossover thresholds for impl="auto", from the committed v5e probe
+# (results/benchmarks/attention/flash_block_probe.jsonl, round 4): the
+# flash kernel's train-step TFLOPS pass XLA's dense attention between
+# 2k and 4k (35-44 vs ~15.8 at 4k) while XLA leads ~7x at 1k forward;
+# below the threshold the [T, T] logits tensor fits comfortably and
+# XLA's single fused program beats the kernel's grid overhead.
+PALLAS_MIN_SEQ = 4096
+PALLAS_MAX_HEAD_DIM = 128  # larger head dims have no probe coverage
+
+
+def select_attention_impl(
+    seq_len: int, head_dim: int, mode: str = "train"
+) -> str:
+    """Resolve impl="auto" to "pallas" or "xla" from call geometry.
+
+    The choice is static per traced shape (resolved at trace time, so
+    jit sees ordinary branch-free code). `mode` is a hint for callers
+    that know they are forward-only ("fwd"): the kernel's measured win
+    is train-mode (fwd+bwd, where not materializing [T, T] pays twice);
+    forward-only keeps XLA until the dense logits stop fitting."""
+    if head_dim > PALLAS_MAX_HEAD_DIM or seq_len % 128:
+        return "xla"
+    if mode == "fwd":
+        # fwd-only crossover sits higher: XLA fwd leads through 2k and
+        # the kernel's fwd win only shows at 4k+ with big tiles; be
+        # conservative and require 2x the train threshold
+        return "pallas" if seq_len >= 2 * PALLAS_MIN_SEQ else "xla"
+    return "pallas" if seq_len >= PALLAS_MIN_SEQ else "xla"
 
 
 def causal_mask(q_len: int, kv_len: int, dtype=jnp.bool_) -> jax.Array:
@@ -76,6 +110,11 @@ def dot_product_attention(
     """
     if q.ndim != 4 or k.shape != v.shape or q.shape[-1] != k.shape[-1]:
         raise ValueError(f"bad attention shapes q={q.shape} k={k.shape} v={v.shape}")
+    if impl in ("auto", "auto:fwd"):
+        impl = select_attention_impl(
+            q.shape[1], q.shape[-1],
+            mode="fwd" if impl.endswith(":fwd") else "train",
+        )
     if impl == "pallas":
         try:
             from hyperion_tpu.ops.pallas.flash_attention import flash_attention

@@ -93,6 +93,21 @@ LANES = 128     # lane-broadcast width for per-row stats (lse/delta)
 SUBLANES = 8    # sublane-broadcast height for the padding mask
 
 
+def default_blocks(head_dim: int) -> tuple[int, int]:
+    """Head-dim-aware default tile sizes.
+
+    The 1024x1024 sweep above ran at D=64 only; at D=128 (the Llama
+    geometry) every (block, D) operand tile doubles and the backward
+    holds four extra fp32 (block_q, block_kv) intermediates near the
+    VMEM edge where 2048-wide tiles already fail at D=64. Until a
+    D=128 on-chip sweep (scripts/flash_block_probe.py --head-dim 128)
+    says otherwise, halve block_kv at D>=128 — the q-tile stays wide so
+    the MXU contraction stays long."""
+    if head_dim >= 128:
+        return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_KV // 2
+    return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_KV
+
+
 def _pick_block(T: int, want: int) -> int:
     """Resolve a block size against sequence length T.
 
@@ -101,8 +116,10 @@ def _pick_block(T: int, want: int) -> int:
     multi-tile paths. Otherwise pick the largest 128-multiple divisor
     of T that is <= want (128-multiples keep the lse/delta rank-1
     blocks Mosaic-legal); a short sequence with no such divisor runs as
-    one T-wide tile, and a long one raises rather than silently
-    compiling a VMEM-busting single tile."""
+    one T-wide tile (with a warning above 1024, where the fp32 logits
+    tile alone passes 4 MB and 2048x2048 is a known compile failure),
+    and a long one raises rather than silently compiling a VMEM-busting
+    single tile."""
     b = min(want, T)
     if T % b == 0:
         return b
@@ -112,6 +129,17 @@ def _pick_block(T: int, want: int) -> int:
             return c
         c -= 128
     if T <= 2048:
+        if T > 1024:
+            import warnings
+
+            warnings.warn(
+                f"flash_attention: seq length {T} has no 128-multiple "
+                f"block divisor <= {want}; falling back to one {T}-wide "
+                f"tile ({T * T * 4 / 2**20:.0f} MB fp32 logits per "
+                "program, near the VMEM edge) — pad the sequence to a "
+                "multiple of 128 for tiled execution",
+                stacklevel=3,
+            )
         return T
     raise ValueError(
         f"seq length {T} has no 128-multiple block divisor <= {want}; "
@@ -547,18 +575,22 @@ def _flash(causal, block_q, block_kv, q, k, v, padding_mask):
 
 def flash_attention(
     q, k, v, *, causal: bool = False, padding_mask=None,
-    block_q: int = DEFAULT_BLOCK_Q, block_kv: int = DEFAULT_BLOCK_KV,
+    block_q: int | None = None, block_kv: int | None = None,
 ):
     """Drop-in for `ops.attention.dot_product_attention` over
-    [B, T, H, D] tensors. padding_mask: [B, Tkv], 1 = real token."""
+    [B, T, H, D] tensors. padding_mask: [B, Tkv], 1 = real token.
+
+    block_q/block_kv default per head_dim (`default_blocks`); mixed
+    q/k/v dtypes are reconciled to q's dtype (the kernels drive the MXU
+    in one input dtype, no fp32 upcast — matching the XLA impl, which
+    also computes in q's dtype)."""
     if not (q.dtype == k.dtype == v.dtype):
-        # the kernels drive the MXU in the input dtype (no fp32
-        # upcast), so dot_general needs matching operands
-        raise TypeError(
-            f"flash_attention requires matching q/k/v dtypes, got "
-            f"{q.dtype}/{k.dtype}/{v.dtype}"
-        )
-    return _flash(causal, block_q, block_kv, q, k, v, padding_mask)
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
+    dq, dkv = default_blocks(q.shape[-1])
+    return _flash(
+        causal, block_q or dq, block_kv or dkv, q, k, v, padding_mask
+    )
 
 
 def _fwd(causal, block_q, block_kv, q, k, v, padding_mask):

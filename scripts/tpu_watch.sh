@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Opportunistic capture loop (VERDICT r3 item 1): the axon tunnel is
 # intermittent, so probe jax.devices() with a hard timeout every
-# PROBE_SLEEP seconds all round and fire scripts/capture_round4.sh on the
+# PROBE_SLEEP seconds all round and fire the capture script on the
 # first success. A plain jax.devices() call blocks FOREVER when the
 # tunnel is down (memory: axon-tunnel-flaky), hence the timeout wrapper
 # and the platform assert (a downed tunnel can also fall back to the CPU
@@ -35,7 +35,7 @@ assert d.platform == 'tpu', f'backend is {d.platform}, not tpu'
 print('tpu up:', getattr(d, 'device_kind', '?'))
 " 2>/dev/null; then
     echo "[watch] tunnel up at $(date -u +%FT%TZ) — starting capture"
-    bash scripts/capture_round4.sh
+    bash "${CAPTURE_SCRIPT:-scripts/capture_round5.sh}"
     rc=$?
     if [ "$rc" -eq 0 ]; then
       echo "[watch] capture complete"

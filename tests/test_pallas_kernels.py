@@ -9,8 +9,14 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from hyperion_tpu.ops.attention import dot_product_attention
-from hyperion_tpu.ops.pallas.flash_attention import flash_attention
+from hyperion_tpu.ops.attention import (
+    dot_product_attention,
+    select_attention_impl,
+)
+from hyperion_tpu.ops.pallas.flash_attention import (
+    default_blocks,
+    flash_attention,
+)
 from hyperion_tpu.ops.pallas.fused_norm import fused_layernorm, fused_rmsnorm
 
 
@@ -156,6 +162,76 @@ class TestFlashAttention:
         ref = dot_product_attention(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=3e-5, rtol=3e-5)
+
+    def test_wide_single_tile_fallback_warns(self):
+        # an indivisible mid-length sequence still runs, but no longer
+        # silently: one 1100-wide fp32 logits tile is near the 2048^2
+        # VMEM edge the module documents (ADVICE r4)
+        from hyperion_tpu.ops.pallas.flash_attention import _pick_block
+
+        with pytest.warns(UserWarning, match="1100-wide tile"):
+            assert _pick_block(1100, 1024) == 1100
+        # short fallbacks stay silent
+        assert _pick_block(48, 32) == 48
+
+    def test_mixed_dtypes_reconciled_to_q(self):
+        # bf16 q with fp32 k/v (e.g. a half-converted cache) computes in
+        # q's dtype instead of raising — parity with the XLA impl's
+        # q-dtype compute (ADVICE r4)
+        q, k, v = qkv(shape=(1, 32, 2, 8))
+        out = flash_attention(q.astype(jnp.bfloat16), k, v,
+                              block_q=16, block_kv=16)
+        assert out.dtype == jnp.bfloat16
+        ref = flash_attention(q.astype(jnp.bfloat16),
+                              k.astype(jnp.bfloat16),
+                              v.astype(jnp.bfloat16),
+                              block_q=16, block_kv=16)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_default_blocks_head_dim_aware(self):
+        # D=64 keeps the swept 1024x1024; D=128 (Llama) halves block_kv
+        # until an on-chip D=128 sweep validates wider (ADVICE r4)
+        assert default_blocks(64) == (1024, 1024)
+        assert default_blocks(128) == (1024, 512)
+
+
+class TestAttentionImplAutoSelect:
+    """Geometry-aware impl="auto" resolution (VERDICT r4 item 6)."""
+
+    def test_short_seq_keeps_xla(self):
+        assert select_attention_impl(128, 64) == "xla"
+        assert select_attention_impl(2048, 64) == "xla"
+
+    def test_long_train_gets_pallas(self):
+        assert select_attention_impl(4096, 64) == "pallas"
+        assert select_attention_impl(16384, 128) == "pallas"
+
+    def test_fwd_mode_crossover_is_higher(self):
+        assert select_attention_impl(4096, 64, mode="fwd") == "xla"
+        assert select_attention_impl(8192, 64, mode="fwd") == "pallas"
+
+    def test_unprobed_geometry_stays_xla(self):
+        assert select_attention_impl(4096, 256) == "xla"       # big head
+        assert select_attention_impl(4100, 64) == "xla"        # not 128-mult
+
+    def test_auto_dispatches_through_attention(self):
+        # short seq through impl="auto" matches the xla path exactly
+        q, k, v = qkv(shape=(1, 32, 2, 8))
+        auto = dot_product_attention(q, k, v, causal=True, impl="auto")
+        ref = dot_product_attention(q, k, v, causal=True, impl="xla")
+        np.testing.assert_allclose(np.asarray(auto), np.asarray(ref))
+
+    def test_tier_default_is_auto(self):
+        from hyperion_tpu.config import Config
+        from hyperion_tpu.train.trainer import _tier_impls
+
+        cfg = Config()
+        cfg.optimization.compile_tier = "jit+pallas"
+        assert _tier_impls(cfg)["attention_impl"] == "auto"
+        cfg.optimization.attention_impl = "pallas"  # explicit wins
+        assert _tier_impls(cfg)["attention_impl"] == "pallas"
 
 
 class TestFusedLayerNorm:
