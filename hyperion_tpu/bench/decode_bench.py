@@ -64,9 +64,9 @@ def _init_model(name: str, **overrides):
 
 def benchmark_decode(
     name: str, batch: int = 8, prompt_len: int = 128, decode_len: int = 64,
-    quant: str = "none",
+    quant: str = "none", **overrides,
 ) -> dict:
-    cfg, model, params = _init_model(name)
+    cfg, model, params = _init_model(name, **overrides)
     if quant == "int8":
         # weight-only int8 (precision/quant.py): kernels become int8 +
         # per-channel scales — half bf16's weight HBM traffic, which is
@@ -162,8 +162,50 @@ def benchmark_decode(
     }
 
 
+# draft window shared by benchmark_speculative and the breakeven
+# analysis — one constant so the JSON verdict is always computed for
+# the same k as the measured gen1_spec rows beside it
+SPEC_K = 4
+
+
+def spec_breakeven_acceptance(
+    draft_ms: float, target_ms: float, k: int = SPEC_K
+) -> float:
+    """Per-token draft/target agreement probability above which k-token
+    speculation beats plain greedy decode (the analysis VERDICT r4
+    item 8 asks for, computed from measured per-forward times).
+
+    Plain emits 1 token per `target_ms`. A speculative round costs
+    `k * draft_ms + target_ms` and emits E[tokens] =
+    (1 - p^(k+1)) / (1 - p) for per-token acceptance p (the standard
+    geometric acceptance model from the speculative-sampling papers).
+    Breakeven is the p where E[tokens] / round_cost equals
+    1 / target_ms, found by bisection (E is monotone in p). Returns
+    >1.0 when even total acceptance cannot pay for the drafts — the
+    honest 'speculation cannot win here' verdict."""
+    cost_ratio = (k * draft_ms + target_ms) / target_ms
+
+    def expected_tokens(p: float) -> float:
+        if p >= 1.0:
+            return float(k + 1)
+        return (1.0 - p ** (k + 1)) / (1.0 - p)
+
+    if expected_tokens(1.0) <= cost_ratio:
+        # even perfect agreement at best TIES (==) or loses (<):
+        # "beats plain decode" is unattainable
+        return float("inf")
+    lo, hi = 0.0, 1.0
+    for _ in range(60):
+        mid = (lo + hi) / 2
+        if expected_tokens(mid) < cost_ratio:
+            lo = mid
+        else:
+            hi = mid
+    return round(hi, 4)
+
+
 def benchmark_speculative(
-    name: str, prompt_len: int = 128, decode_len: int = 64, k: int = 4,
+    name: str, prompt_len: int = 128, decode_len: int = 64, k: int = SPEC_K,
     draft: str | None = None,
 ) -> list[dict]:
     """Batch-1 whole-generation wall time: plain greedy vs speculative
@@ -305,6 +347,40 @@ def main(argv=None) -> None:
             except Exception as e:  # noqa: BLE001 — per-variant tolerance
                 msg = str(e).splitlines()[0] if str(e) else repr(e)
                 print(f"[decode_bench] {name}/speculative failed: {msg}")
+        if args.speculative and args.spec_draft:
+            # Self-contained breakeven analysis: the gen1 rows amortize
+            # prefill+dispatch over the generation, which is NOT the
+            # per-forward time the cost model needs — measure both
+            # models' batch-1 chained per-token forwards directly and
+            # write the verdict next to the CSV.
+            try:
+                vocab = llama_tiny_config(**MODEL_SPECS[name]).vocab_size
+                tgt = benchmark_decode(
+                    name, 1, args.prompt_len, args.decode_len)
+                dft = benchmark_decode(
+                    args.spec_draft, 1, args.prompt_len, args.decode_len,
+                    vocab_size=vocab)
+                be = spec_breakeven_acceptance(
+                    dft["decode_ms_per_token"],
+                    tgt["decode_ms_per_token"])
+                analysis = {
+                    "target": name, "draft": args.spec_draft, "k": SPEC_K,
+                    "target_fwd_ms": tgt["decode_ms_per_token"],
+                    "draft_fwd_ms": dft["decode_ms_per_token"],
+                    # inf = even total acceptance cannot pay for the
+                    # drafts (kept JSON-strict as a string verdict)
+                    "breakeven_acceptance": (
+                        be if be != float("inf") else "unachievable"),
+                }
+                out.mkdir(parents=True, exist_ok=True)
+                # keyed by target: multiple --models must not clobber
+                # each other's verdicts
+                (out / f"spec_breakeven_{name}.json").write_text(
+                    json.dumps(analysis, indent=2))
+                print(f"[decode_bench] breakeven {json.dumps(analysis)}")
+            except Exception as e:  # noqa: BLE001
+                msg = str(e).splitlines()[0] if str(e) else repr(e)
+                print(f"[decode_bench] breakeven analysis failed: {msg}")
     if rows:
         print(f"[decode_bench] results in {out}/")
 

@@ -50,7 +50,8 @@ run() {  # run <timeout_s> <label> <cmd...>
     return 1
   fi
   echo "[capture] === $label ($(date -u +%FT%TZ), limit ${t}s) ==="
-  timeout "$t" "$@"
+  # -k 30: SIGTERM can be swallowed inside axon backend init; escalate
+  timeout -k 30 "$t" "$@"
   local rc=$?
   if [ $rc -ne 0 ]; then
     echo "[capture] $label rc=$rc — continuing" >&2
@@ -73,7 +74,7 @@ stage() {  # stage <timeout_s> <label> <cmd...> — run once across retries
 }
 
 probe() {
-  timeout "${PROBE_TIMEOUT:-120}" python - <<'EOF'
+  timeout -k 10 "${PROBE_TIMEOUT:-120}" python - <<'EOF'
 import jax
 d = jax.devices()[0]
 assert d.platform == "tpu", f"not a TPU: {d.platform}"

@@ -28,7 +28,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     sleep 120
     continue
   fi
-  if timeout 90 python -c "
+  # -k 10: python can swallow SIGTERM inside axon backend init (observed
+  # r5: a probe child outlived its plain `timeout 90` by minutes and
+  # wedged the whole watch loop) — escalate to SIGKILL after 10 s
+  if timeout -k 10 90 python -c "
 import jax
 d = jax.devices()[0]
 assert d.platform == 'tpu', f'backend is {d.platform}, not tpu'

@@ -34,3 +34,29 @@ class TestConfig:
     def test_unknown_keys_ignored_on_load(self):
         cfg = Config.from_dict({"train": {"epochs": 2, "legacy_field": True}})
         assert cfg.train.epochs == 2
+
+
+class TestCliDataFlags:
+    """--train-split / --data_dir plumbing (round-5 real-data runs)."""
+
+    def test_defaults(self):
+        from hyperion_tpu.cli.main import build_parser, make_config
+
+        args = build_parser().parse_args(["--model", "language_ddp"])
+        cfg = make_config(args, "language_ddp")
+        assert cfg.train.train_split == "train"
+        assert cfg.train.data_dir == ""
+
+    def test_real_data_invocation(self):
+        # the capture_round5.sh invocation: outputs under base_dir,
+        # corpora from data_dir, training on the real test arrow
+        from hyperion_tpu.cli.main import build_parser, make_config
+
+        args = build_parser().parse_args([
+            "--model", "language_ddp", "--train-split", "test",
+            "--data_dir", "data", "--base_dir", "results/tpu_runs",
+        ])
+        cfg = make_config(args, "language_ddp")
+        assert cfg.train.train_split == "test"
+        assert cfg.train.data_dir == "data"
+        assert cfg.train.base_dir == "results/tpu_runs"

@@ -101,3 +101,38 @@ class TestValidation:
         with pytest.raises(ValueError, match="exceeds max_len"):
             generate_speculative(model, variables, model, variables,
                                  prompt, 10_000)
+
+
+class TestBreakevenAcceptance:
+    """spec_breakeven_acceptance — the pure cost model the RESULTS.md
+    pairing analysis uses (decode_bench.spec_breakeven_acceptance)."""
+
+    def test_free_draft_needs_nothing(self):
+        from hyperion_tpu.bench.decode_bench import spec_breakeven_acceptance
+
+        # a zero-cost draft: any acceptance that yields >1 token/round
+        # wins; breakeven is exactly "rounds emit 1 token" -> p=0
+        assert spec_breakeven_acceptance(0.0, 10.0, k=4) == 0.0
+
+    def test_equal_cost_draft_cannot_win(self):
+        from hyperion_tpu.bench.decode_bench import spec_breakeven_acceptance
+
+        # k drafts as expensive as the target: round costs (k+1)x, max
+        # emission is k+1 tokens — total acceptance exactly TIES, which
+        # does not beat plain decode, so the verdict is inf
+        assert spec_breakeven_acceptance(10.0, 10.0, k=4) == float("inf")
+
+    def test_overpriced_draft_is_inf(self):
+        from hyperion_tpu.bench.decode_bench import spec_breakeven_acceptance
+
+        assert spec_breakeven_acceptance(20.0, 10.0, k=4) == float("inf")
+
+    def test_cheap_draft_breakeven_is_moderate(self):
+        from hyperion_tpu.bench.decode_bench import spec_breakeven_acceptance
+
+        # 10x-cheaper draft, k=4: round costs 1.4 target-forwards, so
+        # E[tokens] must reach 1.4 -> p around 0.3-0.5
+        p = spec_breakeven_acceptance(1.0, 10.0, k=4)
+        assert 0.2 < p < 0.6
+        # and the model is monotone: cheaper drafts need less agreement
+        assert spec_breakeven_acceptance(0.5, 10.0, k=4) < p
