@@ -207,7 +207,7 @@ def spec_breakeven_acceptance(
 def benchmark_speculative(
     name: str, prompt_len: int = 128, decode_len: int = 64, k: int = SPEC_K,
     draft: str | None = None,
-) -> list[dict]:
+) -> tuple[list[dict], dict | None]:
     """Batch-1 whole-generation wall time: plain greedy vs speculative
     with the target as its own draft (total acceptance). The pair bounds
     the speculation machinery: `spec_ceiling` is the best case (every
@@ -240,6 +240,7 @@ def benchmark_speculative(
         model, v, model, v, i, decode_len, k=k))
     variants = [("gen1_plain", plain, variables),
                 ("gen1_spec_ceiling", spec, variables)]
+    pair = None  # (draft cfg/model/vars) when the pairing built
     if draft:
         try:
             # force the draft onto the TARGET's vocab: speculation
@@ -255,6 +256,7 @@ def benchmark_speculative(
             variants.append(
                 (f"gen1_spec_draft_{draft}", spec_draft, variables)
             )
+            pair = (dcfg, dmodel, dvars)
         except Exception as e:  # noqa: BLE001 — a draft-init failure
             # must not cost the plain/ceiling rows already queued
             print(f"[decode_bench] draft {draft} setup failed: "
@@ -289,7 +291,56 @@ def benchmark_speculative(
                 sum(x.size for x in jax.tree.leaves(params)) / 1e6, 1),
         })
         print(f"[decode_bench] {json.dumps(rows[-1])}")
-    return rows
+
+    analysis = None
+    if pair is not None:
+        # Breakeven verdict from measured batch-1 PER-FORWARD times
+        # (the gen1 rows amortize prefill+dispatch, which the cost
+        # model must not include). Reuses the ALREADY-initialized
+        # models — a second 13.5 GB 7B init here cost a capture stage
+        # its time budget once.
+        try:
+            dcfg, dmodel, dvars = pair
+
+            def per_token_ms(mcfg, mmodel, mvars) -> float:
+                pre = jax.jit(lambda v, i: mmodel.apply(
+                    v, i, cache=init_cache(mcfg, 1), cache_index=0))
+                logits, cache = pre(mvars, ids)
+                tok0 = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+
+                def step(cache, tok, idx, v):
+                    lg, cache = mmodel.apply(
+                        v, tok[:, None], cache=cache, cache_index=idx)
+                    return (cache,
+                            jnp.argmax(lg[:, 0], -1).astype(jnp.int32),
+                            idx + 1)
+
+                budget = mcfg.max_len - prompt_len - 1
+                k2 = max(2, min(24, budget))
+                t = time_chained(
+                    step, cache, tok0, jnp.int32(prompt_len), mvars,
+                    k1=max(1, k2 // 3), k2=k2, n_thread=3, max_k2=budget,
+                )
+                return t.per_iter_ms
+
+            t_target = per_token_ms(cfg, model, variables)
+            t_draft = per_token_ms(dcfg, dmodel, dvars)
+            be = spec_breakeven_acceptance(t_draft, t_target, k=k)
+            analysis = {
+                "target": name, "draft": draft, "k": k,
+                "target_fwd_ms": round(t_target, 4),
+                "draft_fwd_ms": round(t_draft, 4),
+                # inf = even total acceptance cannot pay for the
+                # drafts (kept JSON-strict as a string verdict)
+                "breakeven_acceptance": (
+                    be if be != float("inf") else "unachievable"),
+            }
+            print(f"[decode_bench] breakeven {json.dumps(analysis)}")
+        except Exception as e:  # noqa: BLE001 — analysis is a bonus;
+            # never cost the measured rows
+            print(f"[decode_bench] breakeven analysis failed: "
+                  f"{str(e).splitlines()[0][:120]}")
+    return rows, analysis
 
 
 def main(argv=None) -> None:
@@ -340,47 +391,20 @@ def main(argv=None) -> None:
             print(f"[decode_bench] {json.dumps(r)}")
         if args.speculative:
             try:
-                rows.extend(benchmark_speculative(
+                spec_rows, analysis = benchmark_speculative(
                     name, args.prompt_len, args.decode_len,
-                    draft=args.spec_draft))
+                    draft=args.spec_draft)
+                rows.extend(spec_rows)
                 flush()
+                if analysis is not None:
+                    out.mkdir(parents=True, exist_ok=True)
+                    # keyed by target AND draft: neither other targets
+                    # nor a different draft pairing may clobber this
+                    (out / f"spec_breakeven_{name}_{args.spec_draft}"
+                     ".json").write_text(json.dumps(analysis, indent=2))
             except Exception as e:  # noqa: BLE001 — per-variant tolerance
                 msg = str(e).splitlines()[0] if str(e) else repr(e)
                 print(f"[decode_bench] {name}/speculative failed: {msg}")
-        if args.speculative and args.spec_draft:
-            # Self-contained breakeven analysis: the gen1 rows amortize
-            # prefill+dispatch over the generation, which is NOT the
-            # per-forward time the cost model needs — measure both
-            # models' batch-1 chained per-token forwards directly and
-            # write the verdict next to the CSV.
-            try:
-                vocab = llama_tiny_config(**MODEL_SPECS[name]).vocab_size
-                tgt = benchmark_decode(
-                    name, 1, args.prompt_len, args.decode_len)
-                dft = benchmark_decode(
-                    args.spec_draft, 1, args.prompt_len, args.decode_len,
-                    vocab_size=vocab)
-                be = spec_breakeven_acceptance(
-                    dft["decode_ms_per_token"],
-                    tgt["decode_ms_per_token"])
-                analysis = {
-                    "target": name, "draft": args.spec_draft, "k": SPEC_K,
-                    "target_fwd_ms": tgt["decode_ms_per_token"],
-                    "draft_fwd_ms": dft["decode_ms_per_token"],
-                    # inf = even total acceptance cannot pay for the
-                    # drafts (kept JSON-strict as a string verdict)
-                    "breakeven_acceptance": (
-                        be if be != float("inf") else "unachievable"),
-                }
-                out.mkdir(parents=True, exist_ok=True)
-                # keyed by target: multiple --models must not clobber
-                # each other's verdicts
-                (out / f"spec_breakeven_{name}.json").write_text(
-                    json.dumps(analysis, indent=2))
-                print(f"[decode_bench] breakeven {json.dumps(analysis)}")
-            except Exception as e:  # noqa: BLE001
-                msg = str(e).splitlines()[0] if str(e) else repr(e)
-                print(f"[decode_bench] breakeven analysis failed: {msg}")
     if rows:
         print(f"[decode_bench] results in {out}/")
 

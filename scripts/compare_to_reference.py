@@ -233,6 +233,42 @@ def attention_table(root: Path) -> None:
     print()
 
 
+def decode_table(root: Path) -> None:
+    """KV-cache decode + speculative rows (no reference counterpart —
+    it never samples). Chain rows are per-token slopes; gen1 rows are
+    whole-generation jits (prefill amortized in), comparable only with
+    other gen1 rows. The spec_breakeven_*.json verdicts come from
+    measured batch-1 per-forward times (decode_bench.SPEC_K window)."""
+    printed = False
+    for sub in ("decode", "decode_spec"):
+        rows = _read(root / sub / "decode_benchmarks.csv")
+        if not rows:
+            continue
+        if not printed:
+            print("| Source | Model | Mode | Quant | Batch | tok/s | "
+                  "ms/token | Peak MB (source) |")
+            print("|---|---|---|---|---|---|---|---|")
+            printed = True
+        for r in rows:
+            try:
+                tps = float(r["decode_tokens_per_s"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            mem = r.get("lifetime_peak_mb", "—")
+            src = r.get("mem_source", "")
+            print(f"| {sub} | {r.get('model', '—')} | {r.get('mode', '—')} | "
+                  f"{r.get('quant', '—')} | {r.get('batch', '—')} | "
+                  f"{tps:.1f} | {r.get('decode_ms_per_token', '—')} | "
+                  f"{mem}{f' ({src})' if src else ''} |")
+    if not printed:
+        print("(decode CSVs not captured yet)")
+    print()
+    for sub in ("decode", "decode_spec"):
+        for f in sorted((root / sub).glob("spec_breakeven_*.json")):
+            print(f"{f.name}: {f.read_text().strip()}")
+            print()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--root", default="results/benchmarks")
@@ -249,6 +285,8 @@ def main() -> None:
     compile_table(root)
     print("## Long-seq attention (beyond reference)\n")
     attention_table(root)
+    print("## Decode / speculative (beyond reference)\n")
+    decode_table(root)
     print("## Training runs\n")
     training_table(Path(args.runs))
 
