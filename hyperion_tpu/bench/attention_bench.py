@@ -146,7 +146,7 @@ def benchmark_attention(
     return row
 
 
-def main(argv=None) -> int:
+def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--seqs", type=int, nargs="*",
                    default=[1024, 2048, 4096, 8192, 16384])
@@ -156,7 +156,11 @@ def main(argv=None) -> int:
                    choices=sorted(GEOMETRIES))
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--out", default="results/benchmarks/attention")
-    args = p.parse_args(argv)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
 
     out = Path(args.out)
     rows: list[dict] = []

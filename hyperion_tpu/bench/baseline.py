@@ -256,7 +256,7 @@ def precision_comparison(
     return [benchmark_model(name, batch, dt, iters=iters) for dt in dtypes]
 
 
-def main(argv=None) -> None:
+def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--models", nargs="*", default=list(MODEL_SPECS))
     p.add_argument("--batch-size", type=int, default=32)
@@ -270,7 +270,11 @@ def main(argv=None) -> None:
     p.add_argument("--batch-sizes", type=int, nargs="*",
                    default=[1, 2, 4, 8, 16, 32, 64])
     p.add_argument("--out", default="results/benchmarks/baseline")
-    args = p.parse_args(argv)
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
 
     from hyperion_tpu.metrics.plots import (
         plot_baseline_models, plot_batch_scaling, try_plot,

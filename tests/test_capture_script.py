@@ -1,0 +1,117 @@
+"""Capture-script ↔ CLI contract tests.
+
+The round-5 capture stages run unattended in scarce tunnel windows; a
+flag typo costs a full stage attempt (and its retry) before anyone
+notices. These tests extract every `python -m hyperion_tpu...`
+invocation from scripts/capture_round5.sh and drive the REAL argument
+parsers over them, so flag drift fails in CI instead of on the chip.
+"""
+
+from __future__ import annotations
+
+import re
+import shlex
+from pathlib import Path
+
+import pytest
+
+SCRIPT = Path(__file__).resolve().parents[1] / "scripts" / "capture_round5.sh"
+
+
+def _invocations() -> list[list[str]]:
+    """['-m', 'module', args...] for each python -m line (continuations
+    joined)."""
+    text = SCRIPT.read_text()
+    text = re.sub(r"\\\n\s*", " ", text)  # join line continuations
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        m = re.search(r"python -m (hyperion_tpu[\w.]*)\s+(.*)", line)
+        if not m:
+            continue
+        module, rest = m.group(1), m.group(2)
+        # drop shell artifacts after the command proper
+        rest = rest.split("|")[0].split(">")[0]
+        toks = [t for t in shlex.split(rest) if t != ";"]
+        out.append([module, *toks])
+    return out
+
+
+def _sub_vars(toks: list[str]) -> list[str]:
+    # the script's $OUT/$RUNS expand to plain paths; any $VAR is a path
+    return [re.sub(r"\$\{?\w+\}?", "results/x", t) for t in toks]
+
+
+class TestCaptureInvocations:
+    def test_script_exists_and_has_stages(self):
+        text = SCRIPT.read_text()
+        assert text.count("stage ") >= 10
+        # ADVICE r4: re-tuned stages must carry fresh stamp labels
+        for label in ("llama7b_proof_r5", "attention_bench_r5",
+                      "compile_bench_r5", "wikitext_real_ddp_r5"):
+            assert label in text, f"missing stage {label}"
+
+    def test_cli_invocations_parse(self):
+        from hyperion_tpu.cli.main import build_parser
+
+        invocations = [
+            i for i in _invocations() if i[0] == "hyperion_tpu.cli.main"
+        ]
+        assert len(invocations) >= 4  # 7B proof + 2 real-data + tiny lora
+        parser = build_parser()
+        for inv in invocations:
+            args = parser.parse_args(_sub_vars(inv[1:]))  # SystemExit = fail
+            assert args.model in ("llama", "language_ddp", "language_fsdp",
+                                  "cifar", "all", "scaling")
+
+    def test_real_data_stages_use_committed_arrows(self):
+        from hyperion_tpu.cli.main import build_parser
+
+        parser = build_parser()
+        real = []
+        for inv in _invocations():
+            if inv[0] != "hyperion_tpu.cli.main":
+                continue
+            args = parser.parse_args(_sub_vars(inv[1:]))
+            if args.train_split == "test":
+                real.append(args)
+        assert len(real) >= 3  # 7B proof, ddp, fsdp (+ tiny lora)
+        for args in real:
+            assert args.data_dir == "data", (
+                "real-data stages must load from the committed arrows"
+            )
+            # and the committed arrow must actually exist
+            arrow = (SCRIPT.parents[1] / args.data_dir /
+                     "wikitext2_tokenized" / "test")
+            assert list(arrow.glob("data-*.arrow"))
+
+    @pytest.mark.parametrize("module", [
+        "hyperion_tpu.bench.decode_bench",
+        "hyperion_tpu.bench.baseline",
+        "hyperion_tpu.bench.attention_bench",
+        "hyperion_tpu.bench.compile_bench",
+        "hyperion_tpu.bench.hw_explore",
+    ])
+    def test_bench_invocations_parse(self, module):
+        """Drive the REAL bench parsers (build_parser) over the script's
+        argv — argparse choices/types catch bad values, not just
+        unknown flags."""
+        import importlib
+
+        invocations = [i for i in _invocations() if i[0] == module]
+        if not invocations:
+            pytest.skip(f"{module} not invoked by capture_round5.sh")
+        mod = importlib.import_module(module)
+        if not hasattr(mod, "build_parser"):
+            # modules without the split still get flag-name validation
+            src = Path(mod.__file__).read_text()
+            for inv in invocations:
+                for tok in inv[1:]:
+                    if tok.startswith("--"):
+                        assert f'"{tok}"' in src, (
+                            f"{module}: unknown flag {tok}"
+                        )
+            return
+        parser = mod.build_parser()
+        for inv in invocations:
+            parser.parse_args(_sub_vars(inv[1:]))  # SystemExit = failure
