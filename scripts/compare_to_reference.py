@@ -17,7 +17,14 @@ from __future__ import annotations
 import argparse
 import csv
 import json
+import os
+import sys
 from pathlib import Path
+
+# run as `python scripts/compare_to_reference.py`: script dir, not the
+# repo root, is sys.path[0] — add the root so hyperion_tpu imports
+# (the auto-pick column consults ops.attention's crossover table)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # model -> (total_ms, peak_mb, samples_per_s) at batch 32, from
 # BASELINE.md / model_benchmarks.csv.
@@ -196,9 +203,17 @@ def attention_table(root: Path) -> None:
         for r in rows
     }
     seqs = sorted({int(r["seq"]) for r in rows})
+    # impl="auto"'s trace-time choice per row (ops.attention crossover
+    # table) printed beside the measured winner: a row where the two
+    # disagree means the selection table needs retuning from this very
+    # capture — the mismatch is the finding.
+    try:
+        from hyperion_tpu.ops.attention import select_attention_impl
+    except Exception:  # noqa: BLE001 — table must render without jax
+        select_attention_impl = None
     print("| Geometry | Seq | Mode | XLA ms | Flash ms | Speedup | "
-          "XLA temp GB | Flash temp GB |")
-    print("|---|---|---|---|---|---|---|---|")
+          "XLA temp GB | Flash temp GB | auto picks |")
+    print("|---|---|---|---|---|---|---|---|---|")
     for geo in geos:
         for seq in seqs:
             for mode in ("fwd", "train"):
@@ -214,22 +229,42 @@ def attention_table(root: Path) -> None:
                         return r.get("status", "—")
                     return r.get(k, "—")
 
-                speedup = "—"
+                speedup, ratio = "—", None
                 # only when BOTH rows measured: float("nan") parses
                 # fine, so an oom row would otherwise render as "nanx"
                 if (xla and pl and xla.get("status") == "ok"
                         and pl.get("status") == "ok"):
                     try:
-                        speedup = (
-                            f"{float(xla['per_iter_ms']) / float(pl['per_iter_ms']):.2f}x"
-                        )
+                        ratio = (float(xla["per_iter_ms"])
+                                 / float(pl["per_iter_ms"]))
+                        speedup = f"{ratio:.2f}x"
                     except (KeyError, TypeError, ValueError, ZeroDivisionError):
-                        pass
+                        ratio = None
+                pick = "—"
+                if select_attention_impl is not None:
+                    try:
+                        hd = int((xla or pl).get("head_dim") or
+                                 {"gpt2": 64, "llama": 128}.get(geo, 64))
+                        pick = select_attention_impl(int(seq), hd, mode=mode)
+                        picked_row = {"xla": xla, "pallas": pl}.get(pick)
+                        if ratio is not None:
+                            # raw ratio, not the rounded display string:
+                            # a 1.004 near-tie must not flip the verdict
+                            faster = "pallas" if ratio > 1.0 else "xla"
+                            if pick != faster:
+                                pick += " (MISMATCH)"
+                        elif picked_row is not None and \
+                                picked_row.get("status") not in (None, "ok"):
+                            # auto would select an impl whose measurement
+                            # OOM'd/errored — the loudest retuning signal
+                            pick += f" ({picked_row.get('status')}!)"
+                    except Exception:  # noqa: BLE001
+                        pick = "—"
                 print(f"| {geo} | {seq} | {mode} | "
                       f"{cell(xla, 'per_iter_ms')} | "
                       f"{cell(pl, 'per_iter_ms')} | {speedup} | "
                       f"{cell(xla, 'temp_memory_gb')} | "
-                      f"{cell(pl, 'temp_memory_gb')} |")
+                      f"{cell(pl, 'temp_memory_gb')} | {pick} |")
     print()
 
 
