@@ -59,13 +59,16 @@ EXTRA_TIMEOUT_S = int(os.environ.get("HYPERION_BENCH_EXTRA_TIMEOUT", "420"))
 # init. A tiny probe child answers "is the tunnel alive?" in bounded
 # time and is retried more aggressively than the expensive measurement.
 PROBE_TIMEOUT_S = int(os.environ.get("HYPERION_BENCH_PROBE_TIMEOUT", "240"))
-PROBE_RETRIES = int(os.environ.get("HYPERION_BENCH_PROBE_RETRIES", "3"))
+PROBE_RETRIES = int(os.environ.get("HYPERION_BENCH_PROBE_RETRIES", "2"))
 # Hard wall-clock deadline for the whole probe+measure+fallback chain:
-# capture stages wrap bench.py in `timeout 1800`, and a SIGTERM there
-# kills the process BEFORE the parseable failure line prints. Every
-# child timeout below is clamped so the final JSON always gets out
-# with margin to spare.
-DEADLINE_S = int(os.environ.get("HYPERION_BENCH_DEADLINE", "1500"))
+# both the capture stage (`timeout 1800`) and the round driver's own
+# unknown outer limit SIGTERM the process, killing the parseable
+# failure line. The r4 record proves the driver tolerated ~1020s
+# (600s matmul timeout + 420s lm-step timeout, line recorded), so the
+# default keeps the WORST-case dead-tunnel path (2 hung probes + one
+# clamped blind attempt + cpu sanity) under ~1000s. The capture
+# script, which knows its own 1800s budget, raises this via env.
+DEADLINE_S = int(os.environ.get("HYPERION_BENCH_DEADLINE", "1000"))
 
 
 def _chained_matmul_tflops(n: int, k1: int, k2: int):

@@ -1,4 +1,10 @@
 #!/usr/bin/env bash
+# RETIRED — superseded by scripts/capture_round5.sh (fresh r5 stamp
+# labels, real-data stages, hardened bench env). Kept for the round-4
+# provenance record only; tpu_watch.sh no longer invokes it, and its
+# bench stage does not set the HYPERION_BENCH_DEADLINE/PROBE_RETRIES
+# overrides the round-5 script exports.
+#
 # Round-4 real-chip capture (VERDICT r3 items 1-3): headline bench,
 # model-level baseline CSVs, compile tiers, decode, real training runs at
 # the reference's epoch counts, and the Llama-2-7B single-chip proof.

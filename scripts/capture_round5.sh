@@ -22,6 +22,11 @@ export JAX_PLATFORMS=""   # never inherit a test shell's cpu pin
 export PYTHONUNBUFFERED=1 # piped stdout: progress visible + survives SIGTERM
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
 export HYPERION_BENCH_EXTRA_TIMEOUT="${HYPERION_BENCH_EXTRA_TIMEOUT:-900}"
+# the bench_r5 stage's own limit is 1800s — give bench.py most of it
+# (its built-in default is conservative for the round driver's tighter
+# unknown outer limit) plus a third probe retry
+export HYPERION_BENCH_DEADLINE="${HYPERION_BENCH_DEADLINE:-1500}"
+export HYPERION_BENCH_PROBE_RETRIES="${HYPERION_BENCH_PROBE_RETRIES:-3}"
 
 commit() {  # commit <msg> <paths...> — retries around concurrent commits
   local msg="$1"; shift
