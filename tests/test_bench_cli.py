@@ -1,0 +1,174 @@
+"""bench.py driver-harness logic tests (no subprocesses, no backend).
+
+The headline bench is the ONE number the round driver records; its
+probe/retry/deadline chain (VERDICT r4 item 4) must behave under every
+tunnel condition. These tests monkeypatch the child-runner and the
+clock, so each scenario runs in microseconds and asserts on the single
+JSON line main() prints.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    """Fresh bench module (repo-root bench.py is not a package member).
+
+    _last_committed is stubbed out: it shells out to git, and the real
+    subprocess wait loop calls time.sleep — which these tests patch to
+    advance the FAKE clock, corrupting the wall-time accounting."""
+    spec = importlib.util.spec_from_file_location("bench_r5", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod._last_committed = lambda: None
+    return mod
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def monotonic(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+@pytest.fixture()
+def clock(monkeypatch):
+    c = FakeClock()
+    monkeypatch.setattr(time, "monotonic", c.monotonic)
+    monkeypatch.setattr(time, "sleep", c.sleep)
+    return c
+
+
+def run_main(bench, capsys) -> dict:
+    try:
+        bench.main()
+    except SystemExit as e:
+        assert e.code == 0  # a parseable failure line beats a nonzero rc
+    lines = capsys.readouterr().out.strip().splitlines()
+    return json.loads(lines[-1])
+
+
+GOOD_PROBE = {"ok": True, "platform": "tpu", "device_kind": "v5e"}
+CPU_PROBE = {"ok": False, "platform": "cpu", "device_kind": "cpu"}
+GOOD_MEASUREMENT = {
+    "tflops": 150.0, "per_iter_ms": 7.0, "amortized_ms": 7.0,
+    "dispatch_overhead_ms": 60.0, "chain_lengths": [16, 48],
+    "peak_tflops": 197.0, "mfu": 0.76, "scaling_ratio_vs_half_n": 7.9,
+    "plausible": True, "checks": {}, "platform": "tpu", "device_kind": "v5e",
+}
+
+
+def make_runner(bench, clock, script):
+    """script: mode-prefix -> (burn_seconds, result, err). Records calls."""
+    calls = []
+
+    def _run(mode, timeout_s, env=None):
+        calls.append((mode, timeout_s))
+        assert timeout_s > 0, f"non-positive child timeout for {mode}"
+        burn, result, err = script[mode]
+        clock.t += min(burn, timeout_s)
+        if burn > timeout_s:
+            return None, f"{mode} timed out after {timeout_s}s"
+        return result, err
+
+    return _run, calls
+
+
+class TestBenchMain:
+    def test_healthy_tunnel_publishes_live_value(self, bench, clock, capsys,
+                                                 monkeypatch):
+        runner, calls = make_runner(bench, clock, {
+            "--child-probe": (30, GOOD_PROBE, ""),
+            "--child-matmul": (200, GOOD_MEASUREMENT, ""),
+            "--child-lm-step": (100, {"lm_step_ms": 30.0,
+                                      "lm_tokens_per_s": 1e5}, ""),
+        })
+        monkeypatch.setattr(bench, "_run_child", runner)
+        out = run_main(bench, capsys)
+        assert out["value"] == 150.0
+        assert out["platform"] == "tpu"
+        assert "extra" in out and "lm_step_ms" in out["extra"]
+
+    def test_dead_tunnel_emits_failure_with_sanity(self, bench, clock,
+                                                   capsys, monkeypatch):
+        # every probe hangs to its timeout; the blind attempt hangs too;
+        # the cpu sanity row still lands and the line still prints
+        runner, calls = make_runner(bench, clock, {
+            "--child-probe": (10_000, None, ""),
+            "--child-matmul": (10_000, None, ""),
+            "--child-cpu-sanity": (60, {"cpu_matmul_1024_tflops": 0.1}, ""),
+        })
+        monkeypatch.setattr(bench, "_run_child", runner)
+        out = run_main(bench, capsys)
+        assert out["value"] == 0.0
+        # hung probes hand over to the blind attempt, whose (more
+        # specific) timeout becomes the recorded error
+        assert "timed out" in out["error"]
+        assert out["cpu_sanity"]["cpu_matmul_1024_tflops"] == 0.1
+        # total simulated wall time stayed inside the deadline
+        assert clock.t - 1000.0 <= bench.DEADLINE_S
+
+    def test_cpu_fallback_probe_blocks_measurement(self, bench, clock,
+                                                   capsys, monkeypatch):
+        # probes ANSWER but report platform=cpu: the blind attempt must
+        # NOT run (it would measure the host), and the record says why
+        runner, calls = make_runner(bench, clock, {
+            "--child-probe": (20, CPU_PROBE, ""),
+            "--child-cpu-sanity": (60, {"cpu_matmul_1024_tflops": 0.1}, ""),
+        })
+        monkeypatch.setattr(bench, "_run_child", runner)
+        out = run_main(bench, capsys)
+        assert out["value"] == 0.0
+        assert not any(m == "--child-matmul" for m, _ in calls)
+        assert out["probe"]["platform"] == "cpu"
+
+    def test_slow_init_gets_blind_attempt(self, bench, clock, capsys,
+                                          monkeypatch):
+        # probes time out (init slower than the probe window) but the
+        # direct measurement succeeds — the old pre-probe behavior that
+        # must survive for live-but-slow tunnels
+        state = {"n": 0}
+
+        def _run(mode, timeout_s, env=None):
+            assert timeout_s > 0
+            if mode == "--child-probe":
+                clock.t += timeout_s
+                return None, f"{mode} timed out after {timeout_s}s"
+            if mode == "--child-matmul":
+                clock.t += 300
+                return GOOD_MEASUREMENT, ""
+            clock.t += 10
+            return None, "skipped"
+
+        monkeypatch.setattr(bench, "_run_child", _run)
+        out = run_main(bench, capsys)
+        assert out["value"] == 150.0
+
+    def test_all_child_timeouts_positive_under_tight_deadline(
+            self, bench, clock, capsys, monkeypatch):
+        # shrink the deadline: every child timeout handed out must stay
+        # positive (a 0/negative subprocess timeout raises immediately)
+        monkeypatch.setattr(bench, "DEADLINE_S", 300)
+        runner, calls = make_runner(bench, clock, {
+            "--child-probe": (10_000, None, ""),
+            "--child-matmul": (10_000, None, ""),
+            "--child-cpu-sanity": (10_000, None, ""),
+        })
+        monkeypatch.setattr(bench, "_run_child", runner)
+        out = run_main(bench, capsys)
+        assert out["value"] == 0.0
+        assert all(t > 0 for _, t in calls)
