@@ -24,6 +24,15 @@ fence reports dispatch time and once "measured" 41,999 TFLOPS on a
 
 Robustness: measurements run in bounded subprocesses so a hung backend
 cannot hang the driver; failures still print ONE parseable JSON line.
+
+Telemetry: the probe/retry/deadline lifecycle additionally streams as
+`obs` events (probe_attempt, probe_result, measure_attempt,
+measure_result, deadline, cpu_sanity, publish) — opt-in via
+HYPERION_TELEMETRY=1 (appends to results/benchmarks/telemetry.jsonl) or
+HYPERION_TELEMETRY=<path>; summarize with
+`python -m hyperion_tpu.cli.main obs summarize <path>`. The final JSON
+line stays the driver contract; the event stream is how a human
+reconstructs WHICH branch of the chain a weird line came from.
 """
 
 from __future__ import annotations
@@ -187,14 +196,23 @@ def _child_probe() -> None:
 
     d = jax.devices()[0]
     x = jnp.ones((256, 256), jnp.bfloat16)
-    s = float(jnp.sum(x @ x))  # host fetch = the only honest fence here
+    # checksum in fp32: the matmul's per-element 256.0 is bf16-exact, but
+    # a backend that accumulates the bf16 REDUCTION in bf16 rounds the
+    # 16.7M-element sum — a healthy chip would read ok=false and suppress
+    # the headline measurement. fp32 accumulation + a relative tolerance
+    # keeps the check about "did compile+execute+fetch work", not about
+    # the backend's reduction dtype. Host fetch = the only honest fence.
+    s = float(jnp.sum(x @ x, dtype=jnp.float32))
+    expected = 256.0 ** 3
     # platform gate: a downed tunnel can silently fall back to the CPU
     # backend, which must never pass as "tunnel alive" — the 8192^2
     # measurement on host CPU would burn the full timeout for a number
     # the baseline row can't use. Smoke runs on CPU boxes opt in.
     allow_cpu = os.environ.get("HYPERION_BENCH_ALLOW_CPU") == "1"
     print(json.dumps({
-        "ok": s == 256.0 * 256.0 * 256.0 and (d.platform == "tpu" or allow_cpu),
+        "ok": abs(s - expected) / expected < 1e-2
+        and (d.platform == "tpu" or allow_cpu),
+        "checksum": s,
         "platform": d.platform,
         "device_kind": getattr(d, "device_kind", "?"),
     }))
@@ -308,11 +326,26 @@ def _run_child(
 def main() -> None:
     import time
 
+    # lifecycle event stream (opt-in, see module docstring). proc=0 is
+    # passed explicitly so the tracer never imports the jax-loading dist
+    # module in this parent process — children own all jax work.
+    from hyperion_tpu.obs import trace as obs_trace
+
+    # timestamped run id: the stream appends across invocations, so each
+    # bench run must stay separable under `obs summarize --run`
+    tracer = obs_trace.from_env(
+        "results/benchmarks/telemetry.jsonl",
+        run=f"bench_n{N}_{int(time.time())}", proc=0,
+    )
+
     metric = f"matmul_bf16_{N}_tflops"  # baseline only comparable at N=8192
     t_start = time.monotonic()
 
     def remaining() -> float:
         return DEADLINE_S - (time.monotonic() - t_start)
+
+    tracer.event("bench_start", metric=metric, deadline_s=DEADLINE_S,
+                 probe_retries=PROBE_RETRIES)
 
     # Pre-warm probe with retries: answers "tunnel alive?" in bounded
     # time BEFORE committing the long measurement timeout. A flap
@@ -326,9 +359,19 @@ def main() -> None:
     for attempt in range(PROBE_RETRIES):
         if remaining() < 90:
             perr = perr or "deadline reached before probe could run"
+            tracer.event("deadline", where="probe", attempt=attempt,
+                         remaining_s=round(remaining(), 1))
             break
+        tracer.event("probe_attempt", attempt=attempt,
+                     timeout_s=int(min(PROBE_TIMEOUT_S, remaining() - 60)))
         probe, perr = _run_child(
             "--child-probe", int(min(PROBE_TIMEOUT_S, remaining() - 60))
+        )
+        tracer.event(
+            "probe_result", attempt=attempt,
+            ok=bool(probe and probe.get("ok")),
+            answered=probe is not None,
+            platform=(probe or {}).get("platform"), error=perr or None,
         )
         if probe is not None:
             last_probe = probe
@@ -352,35 +395,54 @@ def main() -> None:
         # measurement attempt — the pre-probe code path that used to
         # succeed in this regime. An answered not-ok probe (CPU
         # fallback) skips this: the platform gate said no.
+        tracer.event("measure_attempt", kind="blind",
+                     reason="all probes timed out",
+                     remaining_s=round(remaining(), 1))
         primary, err = _run_child(
             "--child-matmul", int(min(PRIMARY_TIMEOUT_S, remaining() - 120))
         )
+        tracer.event("measure_result", ok=primary is not None,
+                     error=err or None)
     elif probe is not None and remaining() < 240:
         err = (
             "probe ok but deadline reached before the measurement "
             f"could run ({remaining():.0f}s left of {DEADLINE_S}s)"
         )
+        tracer.event("deadline", where="measure",
+                     remaining_s=round(remaining(), 1))
     elif probe is not None:
+        tracer.event("measure_attempt", kind="primary",
+                     remaining_s=round(remaining(), 1))
         primary, err = _run_child(
             "--child-matmul", int(min(PRIMARY_TIMEOUT_S, remaining() - 120))
         )
+        tracer.event("measure_result", ok=primary is not None,
+                     error=err or None)
         # Bounded retry for fast failures (crash/rc!=0) while budget
         # lasts; after a timed-out attempt, one cheap re-probe decides
         # whether the backend is still there before paying again.
         for _ in range(int(os.environ.get("HYPERION_BENCH_RETRIES", "1"))):
             if primary is not None or remaining() < 240:
                 break
+            tracer.event("probe_attempt", attempt=-1, kind="re-probe")
             re_probe, _ = _run_child(
                 "--child-probe", int(min(PROBE_TIMEOUT_S, remaining() - 120))
             )
+            tracer.event("probe_result", attempt=-1, kind="re-probe",
+                         ok=bool(re_probe and re_probe.get("ok")),
+                         answered=re_probe is not None)
             if re_probe is None or not re_probe.get("ok"):
                 break
             if remaining() < 180:
                 break
+            tracer.event("measure_attempt", kind="retry",
+                         remaining_s=round(remaining(), 1))
             primary, err = _run_child(
                 "--child-matmul",
                 int(min(PRIMARY_TIMEOUT_S, remaining() - 120)),
             )
+            tracer.event("measure_result", ok=primary is not None,
+                         error=err or None)
     if primary is None:
         out = {
             "metric": metric,
@@ -405,8 +467,12 @@ def main() -> None:
             out["cpu_sanity"] = (
                 sanity if sanity is not None else {"error": serr}
             )
+            tracer.event("cpu_sanity", ok=sanity is not None,
+                         error=serr or None)
         else:
             out["cpu_sanity"] = {"error": "deadline reached; skipped"}
+            tracer.event("deadline", where="cpu_sanity",
+                         remaining_s=round(remaining(), 1))
         last = _last_committed()
         if last is not None:
             out["last_committed"] = last
@@ -416,6 +482,8 @@ def main() -> None:
                 "last_committed is the most recent git-committed real-chip "
                 "capture, NOT a live number"
             )
+        tracer.event("publish", value=0.0, failed=True, error=err)
+        tracer.close()
         print(json.dumps(out))
         sys.exit(0)  # a parseable failure line beats a nonzero rc
     plausible = bool(primary.get("plausible", False))
@@ -464,6 +532,9 @@ def main() -> None:
             out["extra"] = {"error": extra_err}
     else:
         out["extra"] = {"error": "deadline reached; skipped"}
+    tracer.event("publish", value=out["value"], plausible=plausible,
+                 vs_baseline=out["vs_baseline"])
+    tracer.close()
     print(json.dumps(out))
 
 

@@ -86,6 +86,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "a 7B config on any box")
     p.add_argument("--no-validate", action="store_true",
                    help="skip the per-epoch validation pass")
+    p.add_argument("--no-telemetry", action="store_true",
+                   help="skip the run-telemetry JSONL stream "
+                        "(<base_dir>/telemetry.jsonl; see `hyperion_tpu "
+                        "obs summarize`)")
     p.add_argument("--profile-dir", default="",
                    help="capture a jax.profiler trace of the first epoch "
                         "into this directory (TensorBoard/XProf format)")
@@ -147,6 +151,7 @@ def make_config(args, job: str) -> Config:
     cfg.train.steps_per_epoch = args.steps_per_epoch
     cfg.train.train_split = args.train_split
     cfg.train.validate = not args.no_validate
+    cfg.train.telemetry = not args.no_telemetry
     cfg.train.dry_init = args.dry_init
     cfg.train.profile_dir = args.profile_dir
     cfg.train.seed = args.seed
@@ -198,6 +203,13 @@ def run_job(args, job: str):
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "obs":
+        # telemetry subcommands (`hyperion_tpu obs summarize
+        # <telemetry.jsonl>`) — pure file tools, no devices touched
+        from hyperion_tpu.obs.report import main as obs_main
+
+        return obs_main(argv[1:])
     p = build_parser()
     args = p.parse_args(argv)
     if args.dry_init and args.model == "scaling":

@@ -91,10 +91,16 @@ class TrainConfig:
     # ships REAL WikiText-2 arrows only for validation/test (its train
     # arrow is absent — /root/reference/data/wikitext2_tokenized/train
     # holds metadata only), so real-data runs train on the real test
-    # split (the larger: 4358 rows) and validate on the real val split.
+    # split (the larger: 2891 packed 128-token rows — 4358 is the
+    # pre-filter count; data/wikitext2_tokenized/README.md) and
+    # validate on the real val split.
     train_split: str = "train"
     steps_per_epoch: int = 0         # 0 = full pass; >0 caps steps (smoke/bench runs)
     validate: bool = True            # per-epoch val pass (exceeds reference)
+    # run telemetry (obs/): step spans + per-epoch metric snapshots to
+    # <base_dir>/telemetry.jsonl (appended; primary process only). Reports
+    # via `hyperion obs summarize`. HYPERION_TELEMETRY=0/path overrides.
+    telemetry: bool = True
     profile_dir: str = ""            # jax.profiler trace of epoch 1 (off when empty)
     seed: int = 0
     base_dir: str = "data"

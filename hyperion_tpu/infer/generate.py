@@ -330,9 +330,26 @@ def main(argv=None) -> int:
                         "from weights; must match training)")
     args = p.parse_args(argv)
 
-    tok = ByteBPE.load(args.tokenizer_dir)
-    params = load_gathered(args.ckpt)
-    model, cached = model_from_npz(params, args.max_len, args.moe_top_k)
+    # decode telemetry (opt-in: HYPERION_TELEMETRY=1 or =<path>): load/
+    # compile/decode spans + a tokens/sec gauge, same stream format as
+    # the trainers — `hyperion_tpu obs summarize` reads it directly.
+    import time
+
+    from hyperion_tpu.obs import MetricsRegistry, observe_step, observe_throughput
+    from hyperion_tpu.obs import trace as obs_trace
+
+    # timestamped run id: the stream file is append-only, so each CLI
+    # invocation must stay separable under `obs summarize --run`
+    tracer = obs_trace.from_env(
+        "data/telemetry.jsonl", run=f"generate_{int(time.time())}"
+    )
+    reg = MetricsRegistry()
+
+    with tracer.span("load") as ld:
+        tok = ByteBPE.load(args.tokenizer_dir)
+        params = load_gathered(args.ckpt)
+        model, cached = model_from_npz(params, args.max_len, args.moe_top_k)
+        ld.set(ckpt=args.ckpt, cached=cached)
     if args.quant == "int8":
         from hyperion_tpu.models.transformer_lm import TransformerLMConfig
         from hyperion_tpu.precision.quant import quantize_llama, quantize_lm
@@ -404,8 +421,26 @@ def main(argv=None) -> int:
             "lookup; retrain the tokenizer at or below the model vocab"
         )
     ids = jnp.asarray([tok.encode(args.prompt)], jnp.int32)
-    out = decode({"params": params}, ids, jax.random.key(args.seed))
-    text = tok.decode([t for t in np.asarray(out[0]) if t != tok.eos_id])
+    # The whole generation is ONE compiled program (prefill + token
+    # scan), so the finest honest span is the full decode call: per-token
+    # "steps" inside a lax.scan have no host boundary to time. The span
+    # fences on a host fetch of the output ids — the same wait the CLI
+    # pays anyway to print — so dur is device-honest, and tokens/sec is
+    # emitted as the decode-throughput gauge. The first call's span
+    # includes compile; `decode_step` spans time each jit call.
+    with tracer.span("decode_step", step=0) as sp:
+        out = decode({"params": params}, ids, jax.random.key(args.seed))
+        out_host = np.asarray(out)  # device->host fetch = the fence
+        n_new = int(out_host.shape[-1]) * int(out_host.shape[0])
+        sp.set(tokens=n_new)  # before exit: attrs land in the record
+    dur = max(sp.dur_s, 1e-9)
+    observe_step(reg, dur, tokens=n_new)
+    observe_throughput(reg, dur, 1, tokens=n_new)  # fenced: fetch above
+    tracer.snapshot(reg)
+    tracer.event("generate_done", tokens=n_new,
+                 tokens_per_s=reg.gauge("tokens_per_s").value)
+    tracer.close()
+    text = tok.decode([t for t in out_host[0] if t != tok.eos_id])
     print(args.prompt + text)
     return 0
 

@@ -1,0 +1,34 @@
+"""Run telemetry — span/event tracing, metric gauges, and run summaries.
+
+The reference earned its results by measuring everything (per-phase
+fwd/bwd/opt time, peak memory, throughput — SURVEY §5); this subsystem
+is that discipline made continuous: every entry point (train, bench,
+infer) streams step-level spans and per-epoch metric snapshots to one
+append-only JSONL file, and `hyperion obs summarize <telemetry.jsonl>`
+turns any run's stream into a markdown report (p50/p99 step time, MFU,
+tokens/sec, memory high-water, slowest spans) without re-running under
+a profiler.
+
+Three parts:
+  * `trace`    — nestable spans + point events, one JSONL line each,
+                 run-id/step/process-index/monotonic-timestamp on every
+                 record; optional `host_fence`-backed device timing at
+                 epoch boundaries (never inside the step loop).
+  * `registry` — counters/gauges/histograms with a per-step
+                 `snapshot()`, plus built-in helpers for tokens/sec,
+                 step-time EMA, device memory, and MFU from compiled
+                 `cost_analysis()` FLOPs vs `utils.chips` peaks.
+  * `report`   — JSONL -> summary dict -> markdown, and the
+                 `obs summarize` CLI subcommand.
+"""
+
+from hyperion_tpu.obs.registry import (  # noqa: F401
+    MetricsRegistry,
+    compiled_flops,
+    mfu_value,
+    observe_device_memory,
+    observe_mfu,
+    observe_step,
+    observe_throughput,
+)
+from hyperion_tpu.obs.trace import Tracer, from_env, null_tracer  # noqa: F401

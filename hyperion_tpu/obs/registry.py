@@ -1,0 +1,297 @@
+"""Counters / gauges / histograms with a per-step `snapshot()`.
+
+The reference kept throughput, phase times, and memory counters in ad-hoc
+local variables per benchmark (`baseline_performance.ipynb` cell 0,
+`benchmarking.py:37-49`); here they are named instruments in one registry
+so every entry point reports the same schema and `obs summarize` can read
+any run. Built-ins cover the four signals the ROADMAP's "as fast as the
+hardware allows" goal needs continuously:
+
+  * tokens/sec + step-time EMA           (`observe_step`)
+  * device memory live/peak              (`observe_device_memory` — the
+    allocator counters with the compiled `memory_analysis` fallback the
+    llama trainer already used; both degrade to 0-free `None` rather
+    than fabricating numbers)
+  * MFU                                  (`compiled_flops` +
+    `mfu_value`: FLOPs from `jit(...).lower().compile().cost_analysis()`
+    against `utils.chips` nominal peaks; on hosts with no tabulated
+    peak — CPU test boxes — a one-time measured matmul peak stands in,
+    and the snapshot says which source was used)
+
+Histograms keep a bounded window (default 8192 observations) plus exact
+running count/sum/min/max, so a week-long run cannot grow memory while
+percentiles stay meaningful over the recent window.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import Any
+
+_EMA_ALPHA = 0.1
+_HIST_WINDOW = 8192
+
+
+def percentile(xs, p: float) -> float:
+    """Nearest-rank percentile of an iterable — the ONE implementation
+    both live histograms and the offline reporter use, so snapshots and
+    `obs summarize` can never disagree on what p50/p99 means."""
+    xs = sorted(xs)
+    if not xs:
+        return float("nan")
+    rank = max(0, min(len(xs) - 1, math.ceil(p / 100.0 * len(xs)) - 1))
+    return xs[rank]
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value: float | None = None
+
+    def set(self, v: float | None) -> None:
+        self.value = None if v is None else float(v)
+
+    def ema(self, v: float, alpha: float = _EMA_ALPHA) -> None:
+        v = float(v)
+        self.value = v if self.value is None else (
+            alpha * v + (1 - alpha) * self.value
+        )
+
+
+class Histogram:
+    __slots__ = ("window", "count", "total", "min", "max")
+
+    def __init__(self, window: int = _HIST_WINDOW):
+        self.window: collections.deque = collections.deque(maxlen=window)
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.window.append(v)
+        self.count += 1
+        self.total += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    def percentile(self, p: float) -> float:
+        """Nearest-rank percentile over the retained window (exact for
+        runs shorter than the window)."""
+        return percentile(self.window, p)
+
+    def summary(self) -> dict:
+        if not self.count:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "mean": self.total / self.count,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+        }
+
+
+class MetricsRegistry:
+    """Get-or-create instruments by name; `snapshot()` is the one wire
+    schema every reader (tracer records, `obs summarize`) consumes."""
+
+    def __init__(self):
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._hists: dict[str, Histogram] = {}
+        self._labels: dict[str, str] = {}
+
+    def counter(self, name: str) -> Counter:
+        return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        return self._gauges.setdefault(name, Gauge())
+
+    def histogram(self, name: str) -> Histogram:
+        return self._hists.setdefault(name, Histogram())
+
+    def set_label(self, name: str, value: str) -> None:
+        """String annotations riding with the numbers (e.g. which peak
+        source an MFU was computed against)."""
+        self._labels[name] = str(value)
+
+    def snapshot(self) -> dict:
+        return {
+            "counters": {k: c.value for k, c in self._counters.items()},
+            "gauges": {k: g.value for k, g in self._gauges.items()},
+            "histograms": {k: h.summary() for k, h in self._hists.items()},
+            "labels": dict(self._labels),
+        }
+
+
+# ------------------------------------------------------------ built-ins
+
+
+def observe_step(
+    reg: MetricsRegistry, duration_s: float, tokens: int | None = None,
+    samples: int | None = None,
+) -> None:
+    """One step's duration (+ what it processed) into the step-time
+    histogram/EMA and the work counters.
+
+    CAVEAT (the same one `bench.py` is built around): under async
+    dispatch a per-step host duration is dispatch latency, not device
+    time — so this feeds the histogram and counters but NOT the
+    throughput gauges. Throughput comes from `observe_throughput` with
+    a FENCED duration (the trainers' end-of-epoch host_fence); callers
+    whose per-step duration is already fenced (CPU test mesh, the
+    generation CLI's device_get) may pass the same duration to both."""
+    ms = duration_s * 1e3
+    reg.histogram("step_time_ms").observe(ms)
+    reg.gauge("step_time_ema_ms").ema(ms)
+    reg.counter("steps").inc()
+    if tokens:
+        reg.counter("tokens").inc(tokens)
+    if samples:
+        reg.counter("samples").inc(samples)
+
+
+def observe_throughput(
+    reg: MetricsRegistry, duration_s: float, steps: int,
+    tokens: int | None = None, samples: int | None = None,
+) -> None:
+    """Throughput gauges from a FENCED wall-clock window covering
+    `steps` steps (tokens/samples are totals over the window). Also
+    records the honest per-step time as `step_time_fenced_ms` — the
+    denominator MFU uses — next to the dispatch-side histogram."""
+    if duration_s <= 0 or steps <= 0:
+        return
+    reg.gauge("step_time_fenced_ms").set(duration_s / steps * 1e3)
+    if tokens:
+        reg.gauge("tokens_per_s").set(tokens / duration_s)
+    if samples:
+        reg.gauge("samples_per_s").set(samples / duration_s)
+
+
+def observe_device_memory(reg: MetricsRegistry) -> None:
+    """Allocator live/peak bytes as MB gauges; backends without
+    `memory_stats` (the axon tunnel, CPU) report None, not 0 — absent
+    evidence must stay distinguishable from an empty chip."""
+    from hyperion_tpu.utils.memory import device_memory_stats
+
+    stats = device_memory_stats()
+    live = stats.get("bytes_in_use")
+    peak = stats.get("peak_bytes_in_use", live)
+    reg.gauge("hbm_live_mb").set(None if live is None else live / 1e6)
+    g = reg.gauge("hbm_peak_mb")
+    mb = None if peak is None else peak / 1e6
+    # high-water: a later epoch must never lower the reported peak
+    if mb is not None and (g.value is None or mb > g.value):
+        g.set(mb)
+
+
+def compiled_flops(jitted, *args, **kwargs) -> float | None:
+    """FLOPs of ONE execution of a jitted function, from XLA's own
+    `cost_analysis()` on the compiled executable. With the jit cache
+    warm this is a re-trace, not a re-compile (same machinery the llama
+    trainer's `compiled_peak_bytes` uses). Returns None when the
+    backend offers no analysis; handles both the dict (jax >= 0.5) and
+    list-of-dicts (0.4.x) return shapes."""
+    try:
+        ca = jitted.lower(*args, **kwargs).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = ca.get("flops") if hasattr(ca, "get") else None
+        return float(flops) if flops and flops > 0 else None
+    except Exception:  # noqa: BLE001 — telemetry must never kill a run
+        return None
+
+
+_MEASURED_HOST_PEAK: list[float | None] = []  # one-element memo
+
+
+def _measured_host_peak_tflops() -> float | None:
+    """Fallback "peak" for hosts whose chip `utils.chips` does not
+    tabulate (CPU test boxes): achieved TFLOPS of a small fp32 matmul,
+    measured once per process with the honest chained-timing harness.
+    Model FLOP throughput on the same host is bounded by it, so the
+    derived MFU stays in (0, 1] — it is utilisation *of this host's
+    measured matmul rate*, clearly labelled `mfu_peak_source:
+    "measured_host"` in snapshots, never comparable to a nominal-peak
+    MFU."""
+    if _MEASURED_HOST_PEAK:
+        return _MEASURED_HOST_PEAK[0]
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from hyperion_tpu.utils.timing import time_chained
+
+        n = 256
+        a = jnp.ones((n, n), jnp.float32)
+        b = jnp.ones((n, n), jnp.float32) * (1.0 / n)
+        res = time_chained(lambda c, b: c @ b, a, b, k1=4, k2=12,
+                           n_thread=1, reps=2)
+        peak = (2 * n**3 / (res.per_iter_ms / 1e3)) / 1e12
+        _MEASURED_HOST_PEAK.append(peak if peak > 0 else None)
+    except Exception:  # noqa: BLE001
+        _MEASURED_HOST_PEAK.append(None)
+    return _MEASURED_HOST_PEAK[0]
+
+
+def mfu_value(
+    flops_per_step: float | None,
+    step_time_s: float,
+    *,
+    dtype: str = "bfloat16",
+    n_devices: int = 1,
+    peak_tflops: float | None = None,
+) -> tuple[float | None, str]:
+    """(mfu fraction, peak source). Pure math once a peak is known:
+    `flops / (t * peak * n_devices)`; peak resolution order is explicit
+    argument -> `utils.chips.nominal_peak_tflops` -> measured host rate
+    -> give up (None)."""
+    if not flops_per_step or step_time_s <= 0:
+        return None, "none"
+    source = "explicit"
+    if peak_tflops is None:
+        from hyperion_tpu.utils.chips import nominal_peak_tflops
+
+        peak_tflops = nominal_peak_tflops(dtype)
+        source = "nominal"
+    if peak_tflops is None:
+        peak_tflops = _measured_host_peak_tflops()
+        source = "measured_host"
+    if not peak_tflops:
+        return None, "none"
+    mfu = flops_per_step / (step_time_s * peak_tflops * 1e12 * n_devices)
+    return mfu, source
+
+
+def observe_mfu(
+    reg: MetricsRegistry,
+    flops_per_step: float | None,
+    step_time_s: float,
+    *,
+    dtype: str = "bfloat16",
+    n_devices: int = 1,
+) -> float | None:
+    mfu, source = mfu_value(
+        flops_per_step, step_time_s, dtype=dtype, n_devices=n_devices
+    )
+    reg.gauge("mfu").set(mfu)
+    if mfu is not None:
+        reg.gauge("flops_per_step").set(flops_per_step)
+        reg.set_label("mfu_peak_source", source)
+    return mfu

@@ -573,6 +573,35 @@ def _flash(causal, block_q, block_kv, q, k, v, padding_mask):
     return out
 
 
+_NARROWING_WARNED: set[tuple[str, str, str]] = set()
+
+
+def _warn_if_narrowing(q_dtype, k_dtype, v_dtype) -> None:
+    """Warn ONCE per dtype combination when reconciling k/v to q's dtype
+    LOSES precision (k/v itemsize > q itemsize) — a bf16 query attending
+    into an fp32 KV cache silently downcasts the cache on every call,
+    which is a real numerics decision the caller should have made
+    explicitly (cast q up, or store the cache in bf16)."""
+    import warnings
+
+    qd = jnp.dtype(q_dtype)
+    for name, d in (("k", jnp.dtype(k_dtype)), ("v", jnp.dtype(v_dtype))):
+        if d.itemsize > qd.itemsize:
+            key = (str(qd), name, str(d))
+            if key in _NARROWING_WARNED:
+                continue
+            _NARROWING_WARNED.add(key)
+            warnings.warn(
+                f"flash_attention: {name} is {d.name} but q is {qd.name}; "
+                f"reconciling to q's dtype NARROWS {name} from "
+                f"{d.itemsize * 8} to {qd.itemsize * 8} bits per element "
+                "(e.g. a bf16 query against an fp32 KV cache). Cast q up, "
+                "or store K/V in the compute dtype, if that precision "
+                "matters. (warned once per dtype combination)",
+                stacklevel=3,
+            )
+
+
 def flash_attention(
     q, k, v, *, causal: bool = False, padding_mask=None,
     block_q: int | None = None, block_kv: int | None = None,
@@ -583,8 +612,10 @@ def flash_attention(
     block_q/block_kv default per head_dim (`default_blocks`); mixed
     q/k/v dtypes are reconciled to q's dtype (the kernels drive the MXU
     in one input dtype, no fp32 upcast — matching the XLA impl, which
-    also computes in q's dtype)."""
+    also computes in q's dtype; a narrowing reconciliation warns once —
+    `_warn_if_narrowing`)."""
     if not (q.dtype == k.dtype == v.dtype):
+        _warn_if_narrowing(q.dtype, k.dtype, v.dtype)
         k = k.astype(q.dtype)
         v = v.astype(q.dtype)
     dq, dkv = default_blocks(q.shape[-1])

@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from hyperion_tpu.utils import compat
+from hyperion_tpu.utils.compat import axis_size, shard_map
 
 from hyperion_tpu.ops.attention import NEG_INF
 from hyperion_tpu.runtime.mesh import AxisName
@@ -43,7 +44,7 @@ def _local_ring_attention(
     """Runs inside shard_map. q/k/v: [B, T_local, H, D] (this device's
     shard); pad: [B, T_local] (1 = real) or None, rotating around the
     ring alongside the K/V block it masks. Returns [B, T_local, H, D]."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
 
@@ -84,9 +85,10 @@ def _local_ring_attention(
         return k_blk, v_blk, pad_blk, m_new, l_new, acc_new
 
     # fori_loop carries must carry the same varying-axes type as the
-    # rotating K/V blocks (jax 0.9 shard_map tracks vma in loop types)
-    vma = tuple(jax.typeof(q).vma)
-    pvary = functools.partial(lax.pcast, axis_name=vma, to="varying")
+    # rotating K/V blocks (jax 0.9 shard_map tracks vma in loop types;
+    # compat.vma_of/pvary no-op on jax versions without vma typing)
+    vma = compat.vma_of(q)
+    pvary = functools.partial(compat.pvary, axes=vma)
     m0 = pvary(jnp.full((B, H, Tl), NEG_INF, jnp.float32))
     l0 = pvary(jnp.zeros((B, H, Tl), jnp.float32))
     acc0 = pvary(jnp.zeros((B, H, Tl, D), jnp.float32))

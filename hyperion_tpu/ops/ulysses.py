@@ -29,7 +29,7 @@ import functools
 import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from hyperion_tpu.utils.compat import shard_map
 
 from hyperion_tpu.ops.attention import dot_product_attention
 from hyperion_tpu.runtime.mesh import AxisName

@@ -35,7 +35,10 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from hyperion_tpu.utils import compat
+from hyperion_tpu.utils.compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from hyperion_tpu.runtime.mesh import AxisName
@@ -61,7 +64,7 @@ def _local_gpipe(
     holds all of them. Returns [1, M, mb, ...]: this stage's output
     buffer; only the last stage's slice is meaningful."""
     params = jax.tree.map(lambda a: a[0], stage_params)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     last = n - 1
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -72,11 +75,11 @@ def _local_gpipe(
     # (via xs), so the carry needs the union — over EVERY param leaf,
     # since in the fsdp-sharded layers path different leaves can vary
     # over different axes (fsdp, model) depending on their specs
-    vma_set = set(jax.typeof(xs).vma)
+    vma_set = set(compat.vma_of(xs))
     for leaf in jax.tree.leaves(params):
-        vma_set |= set(jax.typeof(leaf).vma)
+        vma_set |= set(compat.vma_of(leaf))
     vma = tuple(vma_set)
-    pvary = functools.partial(lax.pcast, axis_name=vma, to="varying")
+    pvary = functools.partial(compat.pvary, axes=vma)
     state0 = pvary(jnp.zeros(xs.shape[1:], xs.dtype))
     out0 = pvary(jnp.zeros(xs.shape, xs.dtype))
 

@@ -51,6 +51,15 @@ from hyperion_tpu.models.lora import (
     trainable_fraction,
 )
 from hyperion_tpu.models.resnet import resnet18
+from hyperion_tpu.obs import (
+    MetricsRegistry,
+    compiled_flops,
+    observe_device_memory,
+    observe_mfu,
+    observe_step,
+    observe_throughput,
+)
+from hyperion_tpu.obs import trace as obs_trace
 from hyperion_tpu.models.transformer_lm import TransformerLM, simple_lm_config
 from hyperion_tpu.parallel.partition import TRANSFORMER_TP_RULES
 from hyperion_tpu.precision.policy import get_policy
@@ -176,6 +185,7 @@ def _epoch_loop(
     eval_batches: ShardedBatches | None = None,
     eval_cols: Callable[[list], dict] | None = None,
     guard: PreemptionGuard | None = None,
+    tracer: obs_trace.Tracer | None = None,
 ) -> tuple[Any, list[EpochRecord], bool]:
     """Returns (state, history, preempted). `preempted=True` means the
     run stopped early on a signal — callers must then skip final exports
@@ -183,6 +193,21 @@ def _epoch_loop(
     gathering 7B params inside a ~30 s preemption grace window invites a
     SIGKILL mid-write)."""
     history: list[EpochRecord] = []
+    # Telemetry (obs/): per-step spans + per-epoch metric snapshots into
+    # <base_dir>/telemetry.jsonl. Spans time the HOST side only — the one
+    # host sync per epoch stays the existing host_fence below, so
+    # instrumentation adds no sync inside the step loop.
+    tracer = tracer or obs_trace.null_tracer()
+    reg = MetricsRegistry()
+    steps_per_epoch = _steps_per_epoch(cfg, batches)
+    # what one step processes, for the throughput gauges (LM jobs count
+    # tokens; cifar counts images)
+    thru_kw = (
+        {"samples": cfg.train.batch_size} if job == "cifar_ddp"
+        else {"tokens": cfg.train.batch_size * cfg.train.seq_len}
+    )
+    flops_per_step: float | None = None
+    flops_known = False  # compute cost_analysis once, not per epoch
     # The simulated-CPU backend's in-process collectives deadlock when the
     # async dispatch queue runs deep (every virtual device shares one
     # thread pool); fencing each step there costs nothing real. On TPU the
@@ -222,9 +247,11 @@ def _epoch_loop(
             profile_this = cfg.train.profile_dir and epoch == resume_epoch
             with profiling.capture(
                 cfg.train.profile_dir if profile_this else None
-            ):
+            ), tracer.span("epoch", step=epoch * steps_per_epoch + start) \
+                    as ep_span:
                 t0 = time.perf_counter()
                 device_metrics = []
+                last_batch = None
                 for i, batch in enumerate(batches.epoch(epoch, start), start):
                     if max_steps and i >= max_steps:
                         break
@@ -234,10 +261,23 @@ def _epoch_loop(
                     if stop_requested():
                         stopping = True
                         break
-                    state, metrics = train_step(state, batch, rng)
+                    # per-step span: host dispatch time only (no fence —
+                    # the acceptance bar for telemetry overhead). On the
+                    # CPU test mesh the pre-existing per-step fence runs
+                    # inside the span, so step spans are device-honest
+                    # exactly where the smoke run reads them.
+                    with tracer.span(
+                        "train_step", step=epoch * steps_per_epoch + i
+                    ) as sp:
+                        state, metrics = train_step(state, batch, rng)
+                        if fence_every_step:
+                            jax.block_until_ready(metrics)
                     device_metrics.append(metrics)  # on device until epoch end
-                    if fence_every_step:
-                        jax.block_until_ready(metrics)
+                    last_batch = batch
+                    # histogram/EMA/counters only: on a lazy backend
+                    # sp.dur_s is dispatch time; the throughput GAUGES
+                    # are set from the fenced epoch duration below
+                    observe_step(reg, sp.dur_s, **thru_kw)
                 # host-fetch fence: on the axon backend block_until_ready
                 # can return before execution, so fetch a scalar of the
                 # last step's metrics (which depends, through the state
@@ -247,7 +287,32 @@ def _epoch_loop(
                 if device_metrics:
                     host_fence(device_metrics[-1])
                 duration = time.perf_counter() - t0  # train-only
-            planned = _steps_per_epoch(cfg, batches) - start
+                ep_span.set(epoch=epoch + 1, steps=len(device_metrics))
+            # per-epoch telemetry: memory high-water, MFU against the
+            # fenced wall time (per-step spans are dispatch-side; the
+            # fenced epoch duration is the honest denominator), one
+            # snapshot record. cost_analysis FLOPs are computed ONCE —
+            # with the jit cache warm this is a re-trace, not a compile.
+            if device_metrics:
+                observe_device_memory(reg)
+                observe_throughput(
+                    reg, duration, len(device_metrics),
+                    **{k: v * len(device_metrics) for k, v in thru_kw.items()},
+                )
+                if not flops_known and last_batch is not None:
+                    flops_per_step = compiled_flops(
+                        train_step, state, last_batch, rng
+                    )
+                    flops_known = True
+                observe_mfu(
+                    reg, flops_per_step, duration / len(device_metrics),
+                    n_devices=n_devices,
+                )
+                tracer.snapshot(
+                    reg, step=epoch * steps_per_epoch + len(device_metrics)
+                    + start, epoch=epoch + 1,
+                )
+            planned = steps_per_epoch - start
             if stopping and len(device_metrics) < planned:
                 # cut short mid-epoch: the state holds every COMPLETED
                 # step; save and exit cleanly. The next run's _prepare_run
@@ -256,6 +321,8 @@ def _epoch_loop(
                 # pollutes the CSV. (A signal arriving AFTER the last
                 # step instead falls through: the finished epoch gets its
                 # row, validation, and epoch-boundary save first.)
+                tracer.event("preempted", epoch=epoch + 1, mid_epoch=True,
+                             steps_done=len(device_metrics))
                 if ckpt_dir:
                     _save_checkpoint(ckpt_dir, state, f"preempt_{epoch}")
                 if dist.is_primary():
@@ -270,12 +337,14 @@ def _epoch_loop(
                 # validation pass (exceeds the reference, which never
                 # evaluated): deterministic order, no dropout, no grads
                 val_metrics = []
-                for i, vbatch in enumerate(eval_batches.epoch(0)):
-                    if max_steps and i >= max_steps:
-                        break
-                    val_metrics.append(eval_step(state, vbatch))
-                if val_metrics:
-                    host_fence(val_metrics[-1])
+                with tracer.span("eval") as ev_span:
+                    for i, vbatch in enumerate(eval_batches.epoch(0)):
+                        if max_steps and i >= max_steps:
+                            break
+                        val_metrics.append(eval_step(state, vbatch))
+                    if val_metrics:
+                        host_fence(val_metrics[-1])
+                    ev_span.set(epoch=epoch + 1, batches=len(val_metrics))
                 # eval_cols must handle an empty list (a val split smaller
                 # than one global batch yields zero batches): the schema
                 # already promises the columns, so NaNs beat a missing-column
@@ -301,11 +370,13 @@ def _epoch_loop(
                     f"loss={loss:.4f}{extras} ({duration:.2f}s)"
                 )
             if ckpt_dir:
-                _save_checkpoint(ckpt_dir, state, str(epoch))
+                with tracer.span("checkpoint", epoch=epoch + 1):
+                    _save_checkpoint(ckpt_dir, state, str(epoch))
             if stopping:
                 # signal arrived at the epoch's end: the epoch is fully
                 # trained, logged, and saved above — stop before starting
                 # the next one. Resume continues at the next epoch.
+                tracer.event("preempted", epoch=epoch + 1, mid_epoch=False)
                 if dist.is_primary():
                     print(f"[{job}] preempted at epoch boundary "
                           f"{epoch + 1}/{cfg.train.epochs}; rerun to resume")
@@ -404,14 +475,31 @@ def _tree_tag(mesh, cfg: Config) -> str:
 
 def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int,
                  extra_schema: tuple = (), tree_tag: str = ""):
-    """CSV logger + checkpoint-restore/resume bookkeeping shared by every
-    trainer. Returns (logger, ckpt_dir, state, resume_epoch, resume_step).
-    `extra_schema` appends columns (e.g. val metrics) after the
-    reference-compatible base columns; `tree_tag` namespaces checkpoint
-    dirs per param-tree variant (`_tree_tag`)."""
+    """CSV logger + telemetry tracer + checkpoint-restore/resume
+    bookkeeping shared by every trainer. Returns (logger, tracer,
+    ckpt_dir, state, resume_epoch, resume_step). `extra_schema` appends
+    columns (e.g. val metrics) after the reference-compatible base
+    columns; `tree_tag` namespaces checkpoint dirs per param-tree
+    variant (`_tree_tag`)."""
     logger = CsvLogger(
         job, n_devices, cfg.train.base_dir,
         schema=SCHEMAS[job] + tuple(extra_schema),
+    )
+    # run telemetry (obs/): append-only <base_dir>/telemetry.jsonl keyed
+    # by the CSV run id so the two streams join; primary process only
+    # (same rank-0 discipline as the CSV), every record still carries the
+    # process index. --no-telemetry / HYPERION_TELEMETRY=0 turns it off.
+    tracer = (
+        obs_trace.from_env(
+            f"{cfg.train.base_dir}/telemetry.jsonl", run=logger.run,
+            enabled_by_default=cfg.train.telemetry,
+        )
+        if dist.is_primary() else obs_trace.null_tracer()
+    )
+    tracer.event(
+        "train_start", job=job, n_devices=n_devices,
+        batch_size=cfg.train.batch_size, seq_len=cfg.train.seq_len,
+        epochs=cfg.train.epochs, backend=jax.default_backend(),
     )
     # world-size-specific, like the reference's run ids: a 2-device run
     # must not resume a 1-device run's checkpoint (their shardings and
@@ -438,7 +526,8 @@ def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int,
             at = f" step {resume_step}" if resume_step else ""
             print(f"[{job}] resumed from step {int(state.step)} "
                   f"(epoch {resume_epoch}{at})")
-    return logger, ckpt_dir, state, resume_epoch, resume_step
+        tracer.event("resumed", step=int(state.step), epoch=resume_epoch)
+    return logger, tracer, ckpt_dir, state, resume_epoch, resume_step
 
 
 def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
@@ -670,7 +759,7 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
         extra_schema = ("lm_loss", "aux_loss") + tuple(extra_schema)
 
     tree_tag = _tree_tag(mesh, cfg)
-    logger, ckpt_dir, state, resume_epoch, resume_step = _prepare_run(
+    logger, tracer, ckpt_dir, state, resume_epoch, resume_step = _prepare_run(
         job, cfg, state, batches, n_dev, extra_schema, tree_tag
     )
     state, history, preempted = _epoch_loop(
@@ -678,7 +767,10 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
         rng=rng, logger=logger, n_devices=n_dev, ckpt_dir=ckpt_dir,
         resume_epoch=resume_epoch, resume_step=resume_step, extra_cols=extra_cols,
         eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
+        tracer=tracer,
     )
+    tracer.event("train_end", preempted=preempted, epochs_run=len(history))
+    tracer.close()
     if not preempted:
         # the final export is namespaced per param tree too: a pipe/MoE
         # run must not clobber the dense export the generation CLI points
@@ -772,7 +864,7 @@ def train_cifar_model(cfg: Config, job: str = "cifar_ddp") -> TrainResult:
 
         extra_schema = ("val_loss", "val_accuracy")
 
-    logger, ckpt_dir, state, resume_epoch, resume_step = _prepare_run(
+    logger, tracer, ckpt_dir, state, resume_epoch, resume_step = _prepare_run(
         job, cfg, state, batches, n_dev, extra_schema
     )
     state, history, preempted = _epoch_loop(
@@ -780,7 +872,10 @@ def train_cifar_model(cfg: Config, job: str = "cifar_ddp") -> TrainResult:
         rng=rng, logger=logger, n_devices=n_dev, extra_cols=accuracy_cols,
         ckpt_dir=ckpt_dir, resume_epoch=resume_epoch, resume_step=resume_step,
         eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
+        tracer=tracer,
     )
+    tracer.event("train_end", preempted=preempted, epochs_run=len(history))
+    tracer.close()
     if not preempted:  # never clobber a final export with half an epoch
         ckpt.export_gathered(
             f"{cfg.train.base_dir}/checkpoints/{job}_final.npz", state.params
@@ -944,7 +1039,7 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
         cfg, splits, mesh, sharding, loss_fn, transform=clamped
     )
 
-    logger, ckpt_dir, state, resume_epoch, resume_step = _prepare_run(
+    logger, tracer, ckpt_dir, state, resume_epoch, resume_step = _prepare_run(
         job, cfg, state, batches, n_dev, extra_schema
     )
     state, history, preempted = _epoch_loop(
@@ -953,7 +1048,10 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
         extra_cols=lambda _: {"mode": mode},
         ckpt_dir=ckpt_dir, resume_epoch=resume_epoch, resume_step=resume_step,
         eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
+        tracer=tracer,
     )
+    tracer.event("train_end", preempted=preempted, epochs_run=len(history))
+    tracer.close()
     if dist.is_primary() and history and not preempted:
         # committed evidence row for "the 7B path at size": step time,
         # tokens/s, peak HBM — the numbers BASELINE.md's Llama row is
@@ -978,11 +1076,23 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
             example = next(iter(batches.epoch(0)))
             peak_bytes = compiled_peak_bytes(train_step, state, example, rng)
             peak_source = "xla_memory_analysis"
-        if not peak_bytes and jax.default_backend() == "tpu":
-            raise RuntimeError(
-                "peak-HBM accounting returned 0 on the TPU backend — "
-                "refusing to write a summary with no memory evidence"
+        if not peak_bytes:
+            # Both memory probes came back empty (the axon backend can
+            # report neither allocator stats nor memory_analysis). A
+            # multi-hour run's step-time/loss evidence must SURVIVE that:
+            # write the summary with an explicit null + provenance
+            # instead of raising away the whole artifact. Readers (and
+            # the fits-in-16GB claim) see "no memory evidence", never a
+            # fabricated 0.0.
+            peak_bytes = None
+            peak_source = (
+                "none — allocator stats and compiled memory_analysis "
+                "both returned 0"
             )
+            if dist.is_primary():
+                print(f"[{job}] warning: no peak-HBM evidence on the "
+                      f"{jax.default_backend()} backend; summary records "
+                      "peak_hbm_mb: null")
 
         steps = _steps_per_epoch(cfg, batches)
         toks_per_epoch = cfg.train.batch_size * cfg.train.seq_len * steps
@@ -998,7 +1108,9 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
             "final_loss": round(history[-1].loss, 4),
             "params_m": round(sum(
                 x.size for x in jax.tree.leaves(state.params)) / 1e6, 1),
-            "peak_hbm_mb": round(peak_bytes / 1e6, 1),
+            "peak_hbm_mb": (
+                None if peak_bytes is None else round(peak_bytes / 1e6, 1)
+            ),
             "peak_hbm_source": peak_source,
             "data_source": splits[tsplit].source,
             "train_split": tsplit,

@@ -158,6 +158,30 @@ class TestBenchMain:
         out = run_main(bench, capsys)
         assert out["value"] == 150.0
 
+    def test_lifecycle_events_stream(self, bench, clock, capsys,
+                                     monkeypatch, tmp_path):
+        # with HYPERION_TELEMETRY pointed at a file, the probe/retry/
+        # deadline chain streams obs events alongside the final JSON line
+        tele = tmp_path / "telemetry.jsonl"
+        monkeypatch.setenv("HYPERION_TELEMETRY", str(tele))
+        runner, calls = make_runner(bench, clock, {
+            "--child-probe": (30, GOOD_PROBE, ""),
+            "--child-matmul": (200, GOOD_MEASUREMENT, ""),
+            "--child-lm-step": (100, {"lm_step_ms": 30.0}, ""),
+        })
+        monkeypatch.setattr(bench, "_run_child", runner)
+        out = run_main(bench, capsys)
+        assert out["value"] == 150.0
+        names = [json.loads(line)["name"]
+                 for line in tele.read_text().splitlines()]
+        assert names[0] == "bench_start"
+        for expected in ("probe_attempt", "probe_result",
+                         "measure_attempt", "measure_result", "publish"):
+            assert expected in names, names
+        publish = [json.loads(line)
+                   for line in tele.read_text().splitlines()][-1]
+        assert publish["value"] == 150.0 and publish["plausible"] is True
+
     def test_all_child_timeouts_positive_under_tight_deadline(
             self, bench, clock, capsys, monkeypatch):
         # shrink the deadline: every child timeout handed out must stay
@@ -172,3 +196,18 @@ class TestBenchMain:
         out = run_main(bench, capsys)
         assert out["value"] == 0.0
         assert all(t > 0 for _, t in calls)
+
+
+class TestChildProbe:
+    def test_fp32_checksum_passes_on_cpu(self, bench, capsys, monkeypatch):
+        # the checksum must accumulate in fp32: a backend summing the
+        # bf16 matmul output in bf16 rounds the 2^24-element reduction
+        # and would mark a HEALTHY device ok=false (ADVICE.md). On the
+        # CPU backend the allow-cpu escape hatch stands in for the
+        # platform gate.
+        monkeypatch.setenv("HYPERION_BENCH_ALLOW_CPU", "1")
+        bench._child_probe()
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["ok"] is True
+        expected = 256.0 ** 3
+        assert abs(out["checksum"] - expected) / expected < 1e-2

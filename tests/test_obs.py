@@ -1,0 +1,372 @@
+"""obs/ telemetry layer tests — tracer, registry, MFU math, summarize.
+
+All CPU-only (conftest pins JAX_PLATFORMS=cpu) and mesh-free: the
+telemetry layer must be testable on any box, with fake clocks where
+timing semantics matter (span nesting/duration) and real jax only where
+the contract IS jax (cost_analysis FLOPs)."""
+
+from __future__ import annotations
+
+import json
+import math
+import warnings
+from pathlib import Path
+
+import pytest
+
+from hyperion_tpu.obs import report
+from hyperion_tpu.obs.registry import (
+    Histogram,
+    MetricsRegistry,
+    compiled_flops,
+    mfu_value,
+    observe_mfu,
+    observe_step,
+    observe_throughput,
+    percentile,
+)
+from hyperion_tpu.obs.trace import ENV_VAR, Tracer, from_env, null_tracer
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def read_jsonl(path) -> list[dict]:
+    return [json.loads(line) for line in Path(path).read_text().splitlines()]
+
+
+def make_tracer(tmp_path, **kw):
+    clk = FakeClock(100.0)
+    wall = FakeClock(1_000_000.0)
+    kw.setdefault("run", "r1")
+    kw.setdefault("proc", 3)
+    t = Tracer(tmp_path / "t.jsonl", clock=clk, wall=wall, **kw)
+    return t, clk
+
+
+class TestTracer:
+    def test_span_nesting_and_fake_clock_timing(self, tmp_path):
+        t, clk = make_tracer(tmp_path)
+        with t.span("epoch", step=0):
+            clk.advance(1.0)
+            with t.span("train_step", step=5) as sp:
+                clk.advance(0.25)
+            clk.advance(0.5)
+        t.close()
+        inner, outer = read_jsonl(t.path)  # inner span exits (writes) first
+        assert inner["name"] == "train_step"
+        assert inner["path"] == "epoch/train_step"
+        assert inner["dur_ms"] == pytest.approx(250.0)
+        assert inner["step"] == 5
+        assert outer["name"] == "epoch"
+        assert outer["path"] == "epoch"
+        assert outer["dur_ms"] == pytest.approx(1750.0)
+        for r in (inner, outer):
+            assert r["run"] == "r1" and r["proc"] == 3 and r["v"] == 1
+            assert r["kind"] == "span"
+        # span handle keeps the duration for callers (registry feeding)
+        assert sp.dur_s == pytest.approx(0.25)
+
+    def test_event_attrs_round_trip(self, tmp_path):
+        t, _ = make_tracer(tmp_path)
+        t.event("probe_result", step=7, ok=True, platform="tpu",
+                nested={"a": [1, 2.5, "x"]}, note="héllo")
+        t.close()
+        (rec,) = read_jsonl(t.path)
+        assert rec["kind"] == "event" and rec["name"] == "probe_result"
+        assert rec["step"] == 7 and rec["ok"] is True
+        assert rec["nested"] == {"a": [1, 2.5, "x"]}
+        assert rec["note"] == "héllo"
+
+    def test_reserved_keys_cannot_be_clobbered_by_attrs(self, tmp_path):
+        t, _ = make_tracer(tmp_path)
+        t.event("x", run="evil", proc=99, kind="span")
+        t.close()
+        (rec,) = read_jsonl(t.path)
+        assert rec["run"] == "r1" and rec["proc"] == 3
+        assert rec["kind"] == "event"
+
+    def test_exception_inside_span_still_records(self, tmp_path):
+        t, _ = make_tracer(tmp_path)
+        with pytest.raises(ValueError):
+            with t.span("boom"):
+                raise ValueError("nope")
+        t.close()
+        (rec,) = read_jsonl(t.path)
+        assert rec["name"] == "boom" and rec["error"] == "ValueError"
+
+    def test_fenced_span_fetches_the_tree(self, tmp_path):
+        import jax.numpy as jnp
+
+        t, _ = make_tracer(tmp_path)
+        with t.span("epoch") as sp:
+            sp.fence(jnp.ones((4,)))
+        t.close()
+        (rec,) = read_jsonl(t.path)
+        assert rec["dur_ms"] is not None
+
+    def test_null_tracer_noops_but_still_times(self, tmp_path):
+        t = null_tracer()
+        with t.span("s") as sp:
+            pass
+        t.event("e")
+        t.snapshot(MetricsRegistry())
+        t.close()
+        assert sp.dur_ms is not None
+        assert not t.enabled
+
+    def test_set_step_default_and_override(self, tmp_path):
+        t, _ = make_tracer(tmp_path)
+        t.set_step(42)
+        t.event("a")
+        t.event("b", step=7)
+        t.close()
+        a, b = read_jsonl(t.path)
+        assert a["step"] == 42 and b["step"] == 7
+
+    def test_from_env_policy(self, tmp_path, monkeypatch):
+        default = tmp_path / "d.jsonl"
+        explicit = tmp_path / "e.jsonl"
+        monkeypatch.setenv(ENV_VAR, "0")
+        assert not from_env(default, enabled_by_default=True).enabled
+        monkeypatch.setenv(ENV_VAR, "1")
+        t = from_env(default)
+        assert t.enabled and t.path == default
+        monkeypatch.setenv(ENV_VAR, str(explicit))
+        t = from_env(default)
+        assert t.enabled and t.path == explicit
+        monkeypatch.delenv(ENV_VAR)
+        assert not from_env(default).enabled
+        assert from_env(default, enabled_by_default=True).enabled
+        assert not from_env(None, enabled_by_default=True).enabled
+
+
+class TestRegistry:
+    def test_snapshot_schema(self):
+        reg = MetricsRegistry()
+        reg.counter("steps").inc(3)
+        reg.gauge("tokens_per_s").set(1234.5)
+        reg.histogram("step_time_ms").observe(10.0)
+        reg.set_label("mfu_peak_source", "nominal")
+        snap = reg.snapshot()
+        assert set(snap) == {"counters", "gauges", "histograms", "labels"}
+        assert snap["counters"]["steps"] == 3
+        assert snap["gauges"]["tokens_per_s"] == 1234.5
+        assert snap["labels"]["mfu_peak_source"] == "nominal"
+        h = snap["histograms"]["step_time_ms"]
+        assert h["count"] == 1 and h["p50"] == 10.0
+        json.dumps(snap)  # must be wire-serializable as-is
+
+    def test_histogram_percentiles_exact(self):
+        h = Histogram()
+        for v in range(1, 101):
+            h.observe(float(v))
+        s = h.summary()
+        assert s["count"] == 100
+        assert s["min"] == 1.0 and s["max"] == 100.0
+        assert s["mean"] == pytest.approx(50.5)
+        assert s["p50"] == 50.0
+        assert s["p90"] == 90.0
+        assert s["p99"] == 99.0
+
+    def test_shared_percentile_is_the_single_definition(self):
+        # report._percentile is the same object, so live snapshots and
+        # offline summaries can never disagree on p50/p99
+        assert report._percentile is percentile
+        assert math.isnan(percentile([], 50))
+        assert percentile([7.0], 99) == 7.0
+
+    def test_histogram_window_bounds_memory_keeps_exact_count(self):
+        h = Histogram(window=8)
+        for v in range(100):
+            h.observe(float(v))
+        assert h.count == 100 and h.max == 99.0 and h.min == 0.0
+        assert len(h.window) == 8  # percentiles over the recent window
+        assert h.percentile(50) >= 92.0
+
+    def test_observe_step_feeds_counters_not_gauges(self):
+        # per-step durations are dispatch-side under async dispatch, so
+        # observe_step must NOT set throughput gauges — only the fenced
+        # observe_throughput may
+        reg = MetricsRegistry()
+        observe_step(reg, 0.5, tokens=4096)
+        observe_step(reg, 0.5, tokens=4096)
+        snap = reg.snapshot()
+        assert "tokens_per_s" not in snap["gauges"]
+        assert snap["counters"]["steps"] == 2
+        assert snap["counters"]["tokens"] == 8192
+        assert snap["histograms"]["step_time_ms"]["p50"] == pytest.approx(500.0)
+
+    def test_observe_throughput_from_fenced_window(self):
+        reg = MetricsRegistry()
+        observe_throughput(reg, 2.0, steps=4, tokens=8192)
+        snap = reg.snapshot()
+        assert snap["gauges"]["tokens_per_s"] == pytest.approx(4096.0)
+        assert snap["gauges"]["step_time_fenced_ms"] == pytest.approx(500.0)
+        # degenerate windows are ignored, not divided by
+        observe_throughput(reg, 0.0, steps=0, tokens=1)
+        assert reg.gauge("tokens_per_s").value == pytest.approx(4096.0)
+
+    def test_gauge_ema(self):
+        g = MetricsRegistry().gauge("x")
+        g.ema(10.0)
+        assert g.value == 10.0
+        g.ema(20.0, alpha=0.5)
+        assert g.value == 15.0
+
+
+class TestMfu:
+    def test_mfu_math_hand_computed(self):
+        # 2 GFLOP per step at 1 ms against a 4-TFLOPS chip:
+        # 2e9 / (1e-3 * 4e12) = 0.5
+        mfu, src = mfu_value(2e9, 1e-3, peak_tflops=4.0)
+        assert mfu == pytest.approx(0.5)
+        assert src == "explicit"
+        # two chips halve utilisation at the same step time
+        mfu2, _ = mfu_value(2e9, 1e-3, peak_tflops=4.0, n_devices=2)
+        assert mfu2 == pytest.approx(0.25)
+
+    def test_mfu_degenerate_inputs(self):
+        assert mfu_value(None, 1.0) == (None, "none")
+        assert mfu_value(1e9, 0.0) == (None, "none")
+
+    def test_compiled_flops_matches_hand_count(self):
+        import jax
+        import jax.numpy as jnp
+
+        n = 64
+        f = jax.jit(lambda a, b: a @ b)
+        flops = compiled_flops(f, jnp.ones((n, n)), jnp.ones((n, n)))
+        # one n^3 matmul = 2n^3 FLOPs (multiply + add), XLA's own count
+        assert flops == pytest.approx(2 * n**3)
+        # and the full pipeline: compiled FLOPs -> MFU against a known peak
+        mfu, _ = mfu_value(flops, 1e-3, peak_tflops=1.0)
+        assert mfu == pytest.approx(2 * n**3 / 1e9)
+
+    def test_observe_mfu_gauge_and_label(self):
+        reg = MetricsRegistry()
+        out = observe_mfu(reg, 2e9, 1e-3, n_devices=1)
+        snap = reg.snapshot()
+        assert out is not None and 0 < out
+        assert snap["gauges"]["mfu"] == out
+        # CPU test box: no nominal peak, so the measured-host fallback
+        # must be labelled as such
+        assert snap["labels"]["mfu_peak_source"] in (
+            "nominal", "measured_host"
+        )
+
+
+def write_fixture_stream(path, runs=("r1", "r2")):
+    """A small synthetic stream: per run, 4 train steps + 1 epoch span +
+    a snapshot + events — what a 1-epoch smoke train emits."""
+    for i, run in enumerate(runs):
+        clk = FakeClock(10.0)
+        wall = FakeClock(1_000.0 + 100 * i)
+        t = Tracer(path, run=run, proc=0, clock=clk, wall=wall)
+        t.event("train_start", job="language_ddp")
+        with t.span("epoch", step=0) as ep:
+            for s in range(4):
+                with t.span("train_step", step=s):
+                    clk.advance(0.010 * (s + 1))  # 10/20/30/40 ms
+            ep.set(epoch=1, steps=4)
+        reg = MetricsRegistry()
+        reg.gauge("tokens_per_s").set(1000.0 * (i + 1))
+        reg.gauge("mfu").set(0.25)
+        reg.gauge("hbm_peak_mb").set(512.0)
+        reg.set_label("mfu_peak_source", "nominal")
+        t.snapshot(reg, step=4)
+        t.event("train_end", preempted=False)
+        t.close()
+
+
+class TestSummarize:
+    def test_summary_fields(self, tmp_path):
+        path = tmp_path / "telemetry.jsonl"
+        write_fixture_stream(path)
+        s = report.summarize(path)  # defaults to the LAST run
+        assert s["run"] == "r2"
+        assert s["runs_in_file"] == 2
+        assert s["steps"] == 4
+        assert s["step_time_ms"]["p50"] == pytest.approx(20.0)
+        assert s["step_time_ms"]["p99"] == pytest.approx(40.0)
+        assert s["tokens_per_s"] == pytest.approx(2000.0)
+        assert s["mfu"] == pytest.approx(0.25)
+        assert s["hbm_peak_mb"] == pytest.approx(512.0)
+        assert s["epochs"] == 1
+        assert s["events"] == {"train_start": 1, "train_end": 1}
+        assert s["slowest_spans"][0]["name"] == "epoch"
+        # explicit run selection
+        assert report.summarize(path, run="r1")["tokens_per_s"] == 1000.0
+
+    def test_markdown_render(self, tmp_path):
+        path = tmp_path / "telemetry.jsonl"
+        write_fixture_stream(path)
+        md = report.render_markdown(report.summarize(path))
+        for needle in ("Telemetry summary", "step time p50", "step time p99",
+                       "tokens/sec", "MFU", "Slowest spans"):
+            assert needle in md, md
+
+    def test_truncated_tail_line_is_skipped(self, tmp_path):
+        path = tmp_path / "telemetry.jsonl"
+        write_fixture_stream(path, runs=("r1",))
+        with path.open("a") as f:
+            f.write('{"v":1,"run":"r1","kind":"ev')  # killed mid-write
+        s = report.summarize(path)
+        assert s["run"] == "r1" and s["steps"] == 4
+
+    def test_cli_summarize(self, tmp_path, capsys):
+        path = tmp_path / "telemetry.jsonl"
+        write_fixture_stream(path)
+        assert report.main(["summarize", str(path)]) == 0
+        assert "Telemetry summary" in capsys.readouterr().out
+        assert report.main(["summarize", str(path), "--json"]) == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["steps"] == 4
+        assert report.main(["summarize", str(tmp_path / "missing.jsonl")]) == 2
+        capsys.readouterr()
+        assert report.main(["summarize", str(path), "--list-runs"]) == 0
+        assert capsys.readouterr().out.split() == ["r1", "r2"]
+
+    def test_cli_via_main_launcher(self, tmp_path, capsys):
+        from hyperion_tpu.cli.main import main as cli_main
+
+        path = tmp_path / "telemetry.jsonl"
+        write_fixture_stream(path)
+        assert cli_main(["obs", "summarize", str(path)]) == 0
+        assert "Telemetry summary" in capsys.readouterr().out
+
+
+class TestNarrowingWarning:
+    def test_warns_once_per_combination(self):
+        import jax.numpy as jnp
+
+        import importlib
+
+        fa = importlib.import_module("hyperion_tpu.ops.pallas.flash_attention")
+
+        fa._NARROWING_WARNED.clear()
+        with pytest.warns(UserWarning, match="NARROWS"):
+            fa._warn_if_narrowing(jnp.bfloat16, jnp.float32, jnp.float32)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # a repeat would raise
+            fa._warn_if_narrowing(jnp.bfloat16, jnp.float32, jnp.float32)
+
+    def test_widening_does_not_warn(self):
+        import jax.numpy as jnp
+
+        import importlib
+
+        fa = importlib.import_module("hyperion_tpu.ops.pallas.flash_attention")
+
+        fa._NARROWING_WARNED.clear()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            fa._warn_if_narrowing(jnp.float32, jnp.bfloat16, jnp.bfloat16)

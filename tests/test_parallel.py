@@ -46,9 +46,9 @@ def _addressable_bytes(tree):
 class TestFsdpSpecs:
     def test_large_params_shard_small_replicate(self, lm_params, mesh8):
         specs = partition_specs(lm_params, mesh8, fsdp_min_size=2**10)
-        flat = jax.tree.leaves_with_path(lm_params)
+        flat = jax.tree_util.tree_leaves_with_path(lm_params)
         flat_specs = {jax.tree_util.keystr(k): v for k, v in
-                      jax.tree.leaves_with_path(specs, is_leaf=lambda x: isinstance(x, P))}
+                      jax.tree_util.tree_leaves_with_path(specs, is_leaf=lambda x: isinstance(x, P))}
         for key, leaf in flat:
             spec = flat_specs[jax.tree_util.keystr(key)]
             if leaf.size >= 2**10:
@@ -76,7 +76,7 @@ class TestTpSpecs:
         specs = partition_specs(lm_params, mesh, tp_rules=TRANSFORMER_TP_RULES,
                                 fsdp=False)
         flat = {jax.tree_util.keystr(k): v for k, v in
-                jax.tree.leaves_with_path(specs, is_leaf=lambda x: isinstance(x, P))}
+                jax.tree_util.tree_leaves_with_path(specs, is_leaf=lambda x: isinstance(x, P))}
         qk = next(v for k, v in flat.items() if "q_proj" in k and "kernel" in k)
         assert qk == P(None, "model")  # trailing Nones trimmed
         ok = next(v for k, v in flat.items() if "o_proj" in k and "kernel" in k)
