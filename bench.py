@@ -329,6 +329,7 @@ def main() -> None:
     # lifecycle event stream (opt-in, see module docstring). proc=0 is
     # passed explicitly so the tracer never imports the jax-loading dist
     # module in this parent process — children own all jax work.
+    from hyperion_tpu.obs import heartbeat as obs_heartbeat
     from hyperion_tpu.obs import trace as obs_trace
 
     # timestamped run id: the stream appends across invocations, so each
@@ -337,6 +338,11 @@ def main() -> None:
         "results/benchmarks/telemetry.jsonl",
         run=f"bench_n{N}_{int(time.time())}", proc=0,
     )
+    # flight recorder (rides the tracer's enablement; pure file IO in
+    # this jax-free parent): phase-per-stage beats let tpu_watch.sh /
+    # `obs doctor` tell "hung inside backend init" from "measuring
+    # slowly" without parsing the stream
+    hb = obs_heartbeat.Heartbeat.for_tracer(tracer)
 
     metric = f"matmul_bf16_{N}_tflops"  # baseline only comparable at N=8192
     t_start = time.monotonic()
@@ -364,6 +370,8 @@ def main() -> None:
             break
         tracer.event("probe_attempt", attempt=attempt,
                      timeout_s=int(min(PROBE_TIMEOUT_S, remaining() - 60)))
+        hb.pulse(phase="probe", attempt=attempt,
+                 timeout_s=int(min(PROBE_TIMEOUT_S, remaining() - 60)))
         probe, perr = _run_child(
             "--child-probe", int(min(PROBE_TIMEOUT_S, remaining() - 60))
         )
@@ -398,6 +406,8 @@ def main() -> None:
         tracer.event("measure_attempt", kind="blind",
                      reason="all probes timed out",
                      remaining_s=round(remaining(), 1))
+        hb.pulse(phase="measure", kind="blind",
+                 timeout_s=int(min(PRIMARY_TIMEOUT_S, remaining() - 120)))
         primary, err = _run_child(
             "--child-matmul", int(min(PRIMARY_TIMEOUT_S, remaining() - 120))
         )
@@ -413,6 +423,8 @@ def main() -> None:
     elif probe is not None:
         tracer.event("measure_attempt", kind="primary",
                      remaining_s=round(remaining(), 1))
+        hb.pulse(phase="measure", kind="primary",
+                 timeout_s=int(min(PRIMARY_TIMEOUT_S, remaining() - 120)))
         primary, err = _run_child(
             "--child-matmul", int(min(PRIMARY_TIMEOUT_S, remaining() - 120))
         )
@@ -459,6 +471,7 @@ def main() -> None:
         # value 0.0 above is then attributable to the tunnel, never to
         # a silently broken harness (VERDICT r4 item 4).
         if remaining() >= 90:
+            hb.pulse(phase="cpu_sanity")
             sanity, serr = _run_child(
                 "--child-cpu-sanity",
                 int(min(PROBE_TIMEOUT_S, remaining() - 30)),
@@ -483,6 +496,7 @@ def main() -> None:
                 "capture, NOT a live number"
             )
         tracer.event("publish", value=0.0, failed=True, error=err)
+        hb.close(phase="done", value=0.0)
         tracer.close()
         print(json.dumps(out))
         sys.exit(0)  # a parseable failure line beats a nonzero rc
@@ -523,6 +537,8 @@ def main() -> None:
             "tunnel time-shares the chip — see last_committed provenance"
         )
     if remaining() >= 120:
+        hb.pulse(phase="lm_step",
+                 timeout_s=int(min(EXTRA_TIMEOUT_S, remaining() - 30)))
         extra, extra_err = _run_child(
             "--child-lm-step", int(min(EXTRA_TIMEOUT_S, remaining() - 30))
         )
@@ -534,6 +550,7 @@ def main() -> None:
         out["extra"] = {"error": "deadline reached; skipped"}
     tracer.event("publish", value=out["value"], plausible=plausible,
                  vs_baseline=out["vs_baseline"])
+    hb.close(phase="done", value=out["value"])
     tracer.close()
     print(json.dumps(out))
 

@@ -118,10 +118,16 @@ def benchmark_attention(
     batch, heads, head_dim = GEOMETRIES[geometry]
     q, k, v = _qkv(seq, dtype, geometry)
     step = (_fwd_step if mode == "fwd" else _train_step)(impl)
+    from hyperion_tpu.ops.pallas.flash_attention import KERNEL_REV
+
     row = {
         "seq": seq, "impl": impl, "mode": mode, "dtype": dtype,
         "geometry": geometry,
         "batch": batch, "heads": heads, "head_dim": head_dim,
+        # stamp the kernel revision so offline comparisons can detect a
+        # capture that predates a kernel retune (compare_to_reference.py
+        # suppresses its auto-pick MISMATCH flag on stale captures)
+        "kernel_rev": KERNEL_REV,
     }
     try:
         res = time_chained(step, q, k, v, k1=k1, k2=k2, n_thread=3)

@@ -89,7 +89,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-telemetry", action="store_true",
                    help="skip the run-telemetry JSONL stream "
                         "(<base_dir>/telemetry.jsonl; see `hyperion_tpu "
-                        "obs summarize`)")
+                        "obs summarize`) AND the heartbeat flight "
+                        "recorder that rides it")
+    p.add_argument("--heartbeat-every", type=int, default=25,
+                   help="rewrite <base_dir>/heartbeat.json every N steps "
+                        "so `obs doctor` / the stage watcher can tell "
+                        "hung from slow (0 = phase transitions only)")
+    p.add_argument("--health-policy", default="warn",
+                   choices=["off", "warn", "checkpoint", "abort"],
+                   help="in-band anomaly escalation (obs/health.py). "
+                        "warn logs `health` events; checkpoint also "
+                        "saves evidence on STATISTICAL anomalies "
+                        "(spikes/explosions — non-finite trees are "
+                        "never saved: they are poisoned); abort stops "
+                        "the run on non-finite loss/grads like a "
+                        "preemption (exports skipped) — the only "
+                        "policy that prevents a diverged final export")
     p.add_argument("--profile-dir", default="",
                    help="capture a jax.profiler trace of the first epoch "
                         "into this directory (TensorBoard/XProf format)")
@@ -152,6 +167,8 @@ def make_config(args, job: str) -> Config:
     cfg.train.train_split = args.train_split
     cfg.train.validate = not args.no_validate
     cfg.train.telemetry = not args.no_telemetry
+    cfg.train.heartbeat_every = args.heartbeat_every
+    cfg.train.health_policy = args.health_policy
     cfg.train.dry_init = args.dry_init
     cfg.train.profile_dir = args.profile_dir
     cfg.train.seed = args.seed
@@ -205,8 +222,9 @@ def run_job(args, job: str):
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "obs":
-        # telemetry subcommands (`hyperion_tpu obs summarize
-        # <telemetry.jsonl>`) — pure file tools, no devices touched
+        # telemetry subcommands (`obs summarize <telemetry.jsonl>`,
+        # `obs doctor <run dir>`, `obs diff <a> <b>`) — pure file
+        # tools, no devices touched
         from hyperion_tpu.obs.report import main as obs_main
 
         return obs_main(argv[1:])

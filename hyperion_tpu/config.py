@@ -101,6 +101,16 @@ class TrainConfig:
     # <base_dir>/telemetry.jsonl (appended; primary process only). Reports
     # via `hyperion obs summarize`. HYPERION_TELEMETRY=0/path overrides.
     telemetry: bool = True
+    # flight recorder (obs/heartbeat.py): rewrite <base_dir>/heartbeat.json
+    # every N steps (and at phase changes) so `obs doctor` and the stage
+    # watcher can tell hung from slow. Rides the telemetry switch; 0
+    # disables the step cadence (phase transitions still pulse).
+    heartbeat_every: int = 25
+    # in-band anomaly policy (obs/health.py): what a FATAL anomaly
+    # (non-finite loss/grads) does to the run. off = no monitoring;
+    # warn = print + trace event; checkpoint = also save a tagged
+    # checkpoint; abort = stop the run (exports skipped, like preemption)
+    health_policy: str = "warn"
     profile_dir: str = ""            # jax.profiler trace of epoch 1 (off when empty)
     seed: int = 0
     base_dir: str = "data"

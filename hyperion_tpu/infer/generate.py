@@ -336,6 +336,7 @@ def main(argv=None) -> int:
     import time
 
     from hyperion_tpu.obs import MetricsRegistry, observe_step, observe_throughput
+    from hyperion_tpu.obs import heartbeat as obs_heartbeat
     from hyperion_tpu.obs import trace as obs_trace
 
     # timestamped run id: the stream file is append-only, so each CLI
@@ -343,6 +344,10 @@ def main(argv=None) -> int:
     tracer = obs_trace.from_env(
         "data/telemetry.jsonl", run=f"generate_{int(time.time())}"
     )
+    # flight recorder (rides the tracer): a decode hung in compile over
+    # the tunnel is distinguishable from one emitting tokens slowly
+    hb = obs_heartbeat.Heartbeat.for_tracer(tracer)
+    hb.pulse(phase="load")
     reg = MetricsRegistry()
 
     with tracer.span("load") as ld:
@@ -428,6 +433,7 @@ def main(argv=None) -> int:
     # pays anyway to print — so dur is device-honest, and tokens/sec is
     # emitted as the decode-throughput gauge. The first call's span
     # includes compile; `decode_step` spans time each jit call.
+    hb.pulse(phase="decode", tokens_requested=args.max_new_tokens)
     with tracer.span("decode_step", step=0) as sp:
         out = decode({"params": params}, ids, jax.random.key(args.seed))
         out_host = np.asarray(out)  # device->host fetch = the fence
@@ -439,6 +445,7 @@ def main(argv=None) -> int:
     tracer.snapshot(reg)
     tracer.event("generate_done", tokens=n_new,
                  tokens_per_s=reg.gauge("tokens_per_s").value)
+    hb.close(phase="done", tokens=n_new)
     tracer.close()
     text = tok.decode([t for t in out_host[0] if t != tok.eos_id])
     print(args.prompt + text)

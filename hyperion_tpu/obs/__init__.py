@@ -9,19 +9,46 @@ turns any run's stream into a markdown report (p50/p99 step time, MFU,
 tokens/sec, memory high-water, slowest spans) without re-running under
 a profiler.
 
-Three parts:
-  * `trace`    — nestable spans + point events, one JSONL line each,
-                 run-id/step/process-index/monotonic-timestamp on every
-                 record; optional `host_fence`-backed device timing at
-                 epoch boundaries (never inside the step loop).
-  * `registry` — counters/gauges/histograms with a per-step
-                 `snapshot()`, plus built-in helpers for tokens/sec,
-                 step-time EMA, device memory, and MFU from compiled
-                 `cost_analysis()` FLOPs vs `utils.chips` peaks.
-  * `report`   — JSONL -> summary dict -> markdown, and the
-                 `obs summarize` CLI subcommand.
+Producer half (PR 1):
+  * `trace`     — nestable spans + point events, one JSONL line each,
+                  run-id/step/process-index/monotonic-timestamp on every
+                  record; optional `host_fence`-backed device timing at
+                  epoch boundaries (never inside the step loop).
+  * `registry`  — counters/gauges/histograms with a per-step
+                  `snapshot()`, plus built-in helpers for tokens/sec,
+                  step-time EMA, device memory, and MFU from compiled
+                  `cost_analysis()` FLOPs vs `utils.chips` peaks.
+  * `report`    — JSONL -> summary dict -> markdown, and the
+                  `obs summarize` CLI subcommand.
+
+Consumer/health half (PR 2 — the stream diagnosing its own runs):
+  * `heartbeat` — atomically-replaced `heartbeat.json` flight recorder
+                  (run/pid/step/phase/timestamps) so an external watcher
+                  can tell hung from slow without parsing the stream.
+  * `health`    — in-band `HealthMonitor`: non-finite loss/grads, loss
+                  spikes (rolling z-score), grad explosions, step-time
+                  stalls; `health` events into the trace + a
+                  warn/checkpoint/abort escalation policy. Consumes
+                  host floats only — it cannot add a device sync.
+  * `doctor`    — `obs doctor <dir>`: classify a run (healthy/crashed/
+                  hung/stalled/diverged) from telemetry + heartbeat,
+                  with evidence.
+  * `diff`      — `obs diff <a> <b>`: percent-delta comparison of two
+                  run summaries with a regression threshold, plus
+                  `--history` trajectory tables over e.g. BENCH_r*.json.
 """
 
+from hyperion_tpu.obs.health import (  # noqa: F401
+    Anomaly,
+    HealthConfig,
+    HealthMonitor,
+)
+from hyperion_tpu.obs.heartbeat import (  # noqa: F401
+    Heartbeat,
+    heartbeat_age_s,
+    null_heartbeat,
+    read_heartbeat,
+)
 from hyperion_tpu.obs.registry import (  # noqa: F401
     MetricsRegistry,
     compiled_flops,

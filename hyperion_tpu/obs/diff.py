@@ -1,0 +1,261 @@
+"""`obs diff <a> <b>` — cross-run regression tracking from summaries.
+
+`BENCH_r*.json` history accumulating in the repo root with nobody
+diffing it was a VERDICT r5 finding; this closes the loop. Two inputs,
+each either a telemetry stream (summarized on the fly via
+`obs/report.py`) or an already-written summary JSON (a bench driver
+record, a bench.py output line, or a trainer `*_summary.json`), are
+normalized onto one metric vocabulary and compared with percent deltas.
+A metric that moved in its BAD direction by more than the threshold
+(default 10%) is flagged as a regression and the exit code says so —
+`obs diff a b || echo regressed` is the whole CI hook.
+
+`--history <glob...>` folds many summaries (e.g. `BENCH_r*.json`) into
+one trajectory table instead, so "how has the headline moved across
+rounds" is one command, not an archaeology session.
+
+Direction conventions: times and memory regress UP; throughput, MFU,
+and vs-baseline regress DOWN.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import math
+import sys
+from pathlib import Path
+
+# canonical metric vocabulary: name -> direction of GOODNESS
+# ("higher" = bigger is better; regression is the other way)
+METRICS: dict[str, str] = {
+    "step_time_p50_ms": "lower",
+    "step_time_p99_ms": "lower",
+    "step_time_mean_ms": "lower",
+    "tokens_per_s": "higher",
+    "samples_per_s": "higher",
+    "mfu": "higher",
+    "hbm_peak_mb": "lower",
+    "headline_tflops": "higher",
+    "vs_baseline": "higher",
+    "lm_step_ms": "lower",
+    "lm_tokens_per_s": "higher",
+}
+
+
+def _num(v) -> float | None:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    v = float(v)
+    return v if math.isfinite(v) else None
+
+
+def normalize(doc: dict) -> dict[str, float]:
+    """Map any known summary shape onto the canonical metric names,
+    keeping only finite numbers. Unknown shapes yield {} rather than
+    guessing."""
+    # round-driver wrapper {"cmd": ..., "rc": ..., "parsed": {...}}
+    if "parsed" in doc and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    out: dict[str, float] = {}
+    st = doc.get("step_time_ms")
+    if isinstance(st, dict):  # obs summarize --json
+        for k, name in (("p50", "step_time_p50_ms"),
+                        ("p99", "step_time_p99_ms"),
+                        ("mean", "step_time_mean_ms")):
+            v = _num(st.get(k))
+            if v is not None:
+                out[name] = v
+    for k in ("tokens_per_s", "samples_per_s", "mfu", "hbm_peak_mb",
+              "vs_baseline"):
+        v = _num(doc.get(k))
+        if v is not None:
+            out[k] = v
+    # bench.py headline line {"metric": "matmul_...", "value": ...}
+    if "metric" in doc:
+        v = _num(doc.get("value"))
+        if v is not None:
+            out["headline_tflops"] = v
+        extra = doc.get("extra")
+        if isinstance(extra, dict):
+            for k in ("lm_step_ms", "lm_tokens_per_s"):
+                v = _num(extra.get(k))
+                if v is not None:
+                    out[k] = v
+    # trainer *_summary.json {"step_ms": ..., "peak_hbm_mb": ...}
+    if "step_ms" in doc:
+        v = _num(doc.get("step_ms"))
+        if v is not None:
+            out["step_time_mean_ms"] = v
+    if "peak_hbm_mb" in doc and "hbm_peak_mb" not in out:
+        v = _num(doc.get("peak_hbm_mb"))
+        if v is not None:
+            out["hbm_peak_mb"] = v
+    return out
+
+
+def load_summary(path: str | Path, run: str | None = None) -> dict:
+    """{"label", "metrics", "error"?} for one input — a run dir, a
+    telemetry JSONL, or a summary JSON file."""
+    from hyperion_tpu.obs import report
+
+    path = Path(path)
+    label = path.name if path.name != "telemetry.jsonl" else path.parent.name
+    if path.is_dir():
+        path = path / "telemetry.jsonl"
+        label = Path(label).name
+    if not path.exists():
+        return {"label": label, "metrics": {}, "error": f"no such file: {path}"}
+    if path.suffix == ".jsonl":
+        s = report.summarize(path, run=run)
+        if s.get("error"):
+            return {"label": label, "metrics": {}, "error": s["error"]}
+        return {"label": s.get("run") or label, "metrics": normalize(s)}
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        return {"label": label, "metrics": {},
+                "error": f"unreadable summary: {e}"}
+    if not isinstance(doc, dict):
+        return {"label": label, "metrics": {},
+                "error": "summary is not a JSON object"}
+    return {"label": label, "metrics": normalize(doc)}
+
+
+def diff(a: dict, b: dict, threshold: float = 0.10) -> dict:
+    """Compare two normalized summaries; delta_pct is b vs a (positive =
+    b larger). A regression is a move in the metric's bad direction
+    strictly beyond `threshold`."""
+    rows = []
+    for name, direction in METRICS.items():
+        va, vb = a["metrics"].get(name), b["metrics"].get(name)
+        if va is None or vb is None or va == 0:
+            continue  # a zero base has no percent delta (a dead-tunnel
+            # 0.0 headline should be triaged by doctor, not diffed)
+        delta = (vb - va) / abs(va)
+        worse = delta > 0 if direction == "lower" else delta < 0
+        rows.append({
+            "metric": name, "a": va, "b": vb,
+            "delta_pct": round(100 * delta, 2),
+            "better": "lower" if direction == "lower" else "higher",
+            "regression": bool(worse and abs(delta) > threshold),
+        })
+    return {
+        "a": a["label"], "b": b["label"],
+        "threshold_pct": round(100 * threshold, 1),
+        "rows": rows,
+        "regressions": [r["metric"] for r in rows if r["regression"]],
+        "comparable_metrics": len(rows),
+    }
+
+
+def render_markdown(d: dict) -> str:
+    lines = [
+        f"## Run diff — `{d['a']}` → `{d['b']}`",
+        "",
+        f"regression threshold: {d['threshold_pct']}% "
+        "(in each metric's bad direction)",
+        "",
+    ]
+    if not d["rows"]:
+        lines.append("no comparable metrics between the two summaries")
+        return "\n".join(lines) + "\n"
+    lines += ["| metric | a | b | Δ% | verdict |", "|---|---|---|---|---|"]
+    for r in d["rows"]:
+        dp = "—" if r["delta_pct"] is None else f"{r['delta_pct']:+.1f}%"
+        verdict = "**REGRESSED**" if r["regression"] else "ok"
+        lines.append(f"| {r['metric']} ({r['better']}=better) | "
+                     f"{r['a']:.4g} | {r['b']:.4g} | {dp} | {verdict} |")
+    if d["regressions"]:
+        lines += ["", f"**{len(d['regressions'])} regression(s):** "
+                  + ", ".join(d["regressions"])]
+    else:
+        lines += ["", "no regressions beyond threshold"]
+    return "\n".join(lines) + "\n"
+
+
+def history(paths: list[str | Path]) -> dict:
+    """Fold many summaries into one trajectory: rows in name order (the
+    naming convention `BENCH_r01 … BENCH_r05` IS the time axis)."""
+    entries = []
+    for p in sorted(paths, key=lambda x: str(x)):
+        s = load_summary(p)
+        entries.append(s)
+    cols = [m for m in METRICS
+            if any(m in e["metrics"] for e in entries)]
+    return {"entries": entries, "columns": cols}
+
+
+def render_history(h: dict) -> str:
+    cols = h["columns"]
+    if not h["entries"]:
+        return "no summaries matched\n"
+    lines = ["## Run history", "",
+             "| summary | " + " | ".join(cols) + " |",
+             "|---|" + "---|" * len(cols)]
+    for e in h["entries"]:
+        cells = []
+        for c in cols:
+            v = e["metrics"].get(c)
+            cells.append("—" if v is None else f"{v:.4g}")
+        note = " (unreadable)" if e.get("error") else ""
+        lines.append(f"| {e['label']}{note} | " + " | ".join(cells) + " |")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="hyperion obs diff",
+        description="compare two run summaries (telemetry JSONL or "
+                    "summary JSON) with a regression threshold, or fold "
+                    "a set of summaries into a trajectory table",
+    )
+    p.add_argument("inputs", nargs="*",
+                   help="two inputs to diff (run dir, telemetry.jsonl, "
+                        "or summary .json)")
+    p.add_argument("--threshold", type=float, default=0.10,
+                   help="regression threshold as a fraction (0.10 = 10%%)")
+    p.add_argument("--run-a", default=None,
+                   help="run id inside input A when it is a stream")
+    p.add_argument("--run-b", default=None,
+                   help="run id inside input B when it is a stream")
+    p.add_argument("--history", nargs="+", default=None, metavar="GLOB",
+                   help="trajectory mode: summarize each file matching "
+                        "the glob(s) (e.g. 'BENCH_r*.json') into one table")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.history:
+        paths: list[str] = []
+        for g in args.history:
+            hits = sorted(_glob.glob(g))
+            paths.extend(hits if hits else ([g] if Path(g).exists() else []))
+        if not paths:
+            print(f"--history matched no files: {args.history}",
+                  file=sys.stderr)
+            return 2
+        h = history(paths)
+        print(json.dumps(h, indent=2, default=str) if args.json
+              else render_history(h), end="" if not args.json else "\n")
+        return 0
+
+    if len(args.inputs) != 2:
+        p.error("need exactly two inputs (or --history)")
+    a = load_summary(args.inputs[0], run=args.run_a)
+    b = load_summary(args.inputs[1], run=args.run_b)
+    for s in (a, b):
+        if s.get("error"):
+            print(f"{s['label']}: {s['error']}", file=sys.stderr)
+            return 2
+    d = diff(a, b, threshold=args.threshold)
+    print(json.dumps(d, indent=2) if args.json else render_markdown(d),
+          end="" if not args.json else "\n")
+    if not d["rows"]:
+        print("nothing comparable between the two inputs", file=sys.stderr)
+        return 2
+    return 1 if d["regressions"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,387 @@
+"""`obs doctor <dir>` — classify a run from its telemetry + heartbeat.
+
+The post-mortem questions a dead capture window always raises — did the
+run finish? crash? hang inside the tunnel? slow down until the stage
+timeout killed it? diverge? — are all answerable from artifacts the run
+already wrote: the JSONL stream (`obs/trace.py`) and the heartbeat file
+(`obs/heartbeat.py`). This module answers them mechanically, so a human
+(or `scripts/tpu_watch.sh`) never re-reads raw logs to learn what a
+run's own telemetry already knows.
+
+Verdicts, in evidence order (first match wins):
+
+  diverged  — fatal `health` events (non-finite loss/grads) or a
+              health-abort in the stream
+  failed    — the run said goodbye while REPORTING failure (a terminal
+              event carrying failed=true / an error attr — bench.py's
+              dead-tunnel 0.0 publish): completed, but not healthy
+  healthy   — a terminal lifecycle event landed (train_end /
+              generate_done / publish); the run said goodbye
+  crashed   — no terminal event AND the stream ends mid-write (the
+              truncated-tail signature of a killed process) or a span
+              recorded an exception
+  hung      — no terminal event and the heartbeat (or, absent one, the
+              stream itself) went stale: the host loop stopped moving.
+              Staleness outranks a stall pattern — a dead process is
+              hung however slow its final recorded steps were (the
+              stall evidence is appended to the reason)
+  stalled   — no terminal event, heartbeat/stream still FRESH, but the
+              tail step spans run far slower than the run's own median
+              — the loop is alive and degrading (do not kill it; watch)
+  running   — no terminal event, heartbeat fresh: leave it alone
+
+Exit codes: 0 healthy/running, 1 failed/crashed/hung/stalled/diverged,
+2 unreadable/empty — so shell watchers can branch on `$?`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from hyperion_tpu.obs.heartbeat import heartbeat_age_s, read_heartbeat
+from hyperion_tpu.obs.registry import percentile
+
+_TERMINAL_EVENTS = ("train_end", "generate_done", "publish")
+_STEP_SPANS = ("train_step", "decode_step")
+_FATAL_KINDS = ("nonfinite_loss", "nonfinite_grad")
+
+# stale thresholds: a heartbeat older than STALE_S with no terminal
+# event means the host loop stopped (beats are time-limited to ~15 s by
+# Heartbeat.interval_s, so 300 s of silence is ~20 missed beats)
+STALE_S = 300.0
+STALL_RATIO = 5.0
+_STALL_TAIL = 3          # steps averaged for the tail
+_STALL_MIN_STEPS = 6     # need a baseline before "slower than usual" means anything
+
+
+def locate(target: str | Path) -> tuple[Path, Path]:
+    """(telemetry_path, heartbeat_path) for a run dir or a direct
+    telemetry.jsonl path (heartbeat is its sibling)."""
+    target = Path(target)
+    if target.is_dir():
+        return target / "telemetry.jsonl", target / "heartbeat.json"
+    return target, target.parent / "heartbeat.json"
+
+
+def read_stream(path: str | Path) -> tuple[list[dict], int, bool]:
+    """(records, n_bad_lines, truncated_tail). Unlike the summarizer's
+    reader this keeps the malformed-line evidence: a final line a killed
+    process never finished writing is the crash signature."""
+    records: list[dict] = []
+    bad = 0
+    truncated_tail = False
+    try:
+        lines = Path(path).read_text(encoding="utf-8").splitlines()
+    except OSError:
+        return [], 0, False
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+            truncated_tail = False
+        except json.JSONDecodeError:
+            bad += 1
+            truncated_tail = i == len(lines) - 1
+    return records, bad, truncated_tail
+
+
+def diagnose(
+    target: str | Path,
+    *,
+    run: str | None = None,
+    now: float | None = None,
+    stale_s: float = STALE_S,
+    stall_ratio: float = STALL_RATIO,
+) -> dict:
+    """Classify one run (default: the last run in the stream)."""
+    tele_path, hb_path = locate(target)
+    records, bad_lines, truncated_tail = read_stream(tele_path)
+    hb = read_heartbeat(hb_path)
+    now = time.time() if now is None else now
+
+    run_ids: dict[str, None] = {}
+    for r in records:
+        if r.get("run"):
+            run_ids.setdefault(r["run"], None)
+    if not run_ids:
+        return {
+            "target": str(target), "run": None, "verdict": "empty",
+            "reason": f"no parseable records in {tele_path}",
+        }
+    run = run or list(run_ids)[-1]
+    recs = [r for r in records if r.get("run") == run]
+    if not recs:
+        return {
+            "target": str(target), "run": run, "verdict": "empty",
+            "reason": f"run {run!r} not found "
+                      f"({len(run_ids)} runs in stream)",
+        }
+    if hb is not None and hb.get("run") not in (None, run):
+        hb = None  # a later run's heartbeat says nothing about this one
+
+    events = [r for r in recs if r.get("kind") == "event"]
+    spans = [r for r in recs if r.get("kind") == "span"]
+    snapshots = [r for r in recs if r.get("kind") == "snapshot"]
+    health = [e for e in events if e.get("name") == "health"]
+    fatal = [e for e in health if e.get("anomaly") in _FATAL_KINDS
+             or e.get("fatal")]
+    terminal = [e for e in events if e.get("name") in _TERMINAL_EVENTS]
+    aborted = any(e.get("name") == "health_abort" for e in events) or any(
+        str(e.get("preempted")) == "health_abort" for e in terminal
+    )
+    errored_spans = [s for s in spans if s.get("error")]
+
+    step_spans = [s for s in spans if s.get("name") in _STEP_SPANS
+                  and isinstance(s.get("dur_ms"), (int, float))]
+    step_ms = [s["dur_ms"] for s in step_spans]
+    steps = [s["step"] for s in recs
+             if isinstance(s.get("step"), (int, float))]
+    last_step = int(max(steps)) if steps else None
+    walls = [r["t_wall"] for r in recs
+             if isinstance(r.get("t_wall"), (int, float))]
+    last_wall = max(walls) if walls else None
+
+    hbm_peak = None
+    for s in snapshots:
+        p = s.get("metrics", {}).get("gauges", {}).get("hbm_peak_mb")
+        if p is not None:
+            hbm_peak = p if hbm_peak is None else max(hbm_peak, p)
+
+    # ---- stall signal: tail steps vs the run's own earlier median ----
+    stall = None
+    if len(step_ms) >= _STALL_MIN_STEPS:
+        tail = step_ms[-_STALL_TAIL:]
+        base = step_ms[:-_STALL_TAIL]
+        base_med = percentile(base, 50)
+        tail_mean = sum(tail) / len(tail)
+        if base_med > 0 and tail_mean >= stall_ratio * base_med:
+            stall = {"tail_mean_ms": round(tail_mean, 3),
+                     "baseline_p50_ms": round(base_med, 3),
+                     "ratio": round(tail_mean / base_med, 1)}
+
+    hb_age = heartbeat_age_s(hb, now) if hb else None
+    stream_age = (now - last_wall) if last_wall is not None else None
+    stale = (
+        hb_age > stale_s if hb_age is not None
+        else stream_age is not None and stream_age > stale_s
+    )
+
+    # ------------------------------------------------------- verdict
+    if fatal or aborted:
+        verdict = "diverged"
+        reason = (
+            f"{len(fatal)} fatal health event(s) "
+            f"({', '.join(sorted({e.get('anomaly', '?') for e in fatal}))})"
+            + ("; run aborted by health policy" if aborted else "")
+        )
+    elif any(e.get("failed") or e.get("error") for e in terminal):
+        # the run completed its lifecycle but REPORTED failure (e.g.
+        # bench.py's dead-tunnel publish with value 0.0, failed=true) —
+        # the motivating silent-0.0 mode must not read as healthy
+        bad = [e for e in terminal if e.get("failed") or e.get("error")][-1]
+        verdict = "failed"
+        reason = (f"terminal event {bad.get('name')!r} reported failure"
+                  + (f": {bad.get('error')}" if bad.get("error") else ""))
+    elif terminal:
+        verdict = "healthy"
+        reason = f"terminal event {terminal[-1].get('name')!r} recorded"
+    elif truncated_tail or errored_spans:
+        verdict = "crashed"
+        reason = (
+            "stream ends mid-write (process killed during a record)"
+            if truncated_tail else
+            f"span {errored_spans[-1].get('name')!r} recorded "
+            f"{errored_spans[-1].get('error')!r}"
+        )
+    elif stale:
+        # Staleness outranks the stall signal: "stalled" means the loop
+        # is alive-and-degrading (watch it, don't kill it) — a process
+        # that stopped beating long ago is dead however slow its final
+        # recorded steps were.
+        verdict = "hung"
+        if hb_age is not None:
+            reason = (f"heartbeat stale: last beat {_age(hb_age)} ago "
+                      f"(phase {hb.get('phase')!r}, step {hb.get('step')}), "
+                      "no terminal event")
+        else:
+            reason = (f"no heartbeat file; stream silent for "
+                      f"{_age(stream_age)} with no terminal event")
+        if stall:
+            reason += (f"; tail steps had degraded {stall['ratio']}x "
+                       "before the loop stopped")
+    elif stall:
+        verdict = "stalled"
+        reason = (
+            f"tail steps {stall['ratio']}x slower than the run's own "
+            f"p50 ({stall['tail_mean_ms']} vs {stall['baseline_p50_ms']} ms)"
+        )
+    elif hb_age is not None:
+        verdict = "running"
+        reason = (f"heartbeat fresh ({_age(hb_age)} ago, "
+                  f"phase {hb.get('phase')!r}, step {hb.get('step')})")
+    else:
+        verdict = "running"
+        reason = "stream active, no terminal event yet"
+
+    last_span = spans[-1] if spans else None
+    return {
+        "target": str(target),
+        "telemetry": str(tele_path),
+        "run": run,
+        "runs_in_file": len(run_ids),
+        "verdict": verdict,
+        "reason": reason,
+        "records": len(recs),
+        "bad_lines": bad_lines,
+        "truncated_tail": truncated_tail,
+        "last_step": last_step,
+        "steps": len(step_ms),
+        "step_time_ms": {
+            "p50": percentile(step_ms, 50),
+            "p99": percentile(step_ms, 99),
+        } if step_ms else None,
+        "stall": stall,
+        "last_span": {
+            "name": last_span.get("name"), "step": last_span.get("step"),
+            "dur_ms": last_span.get("dur_ms"),
+        } if last_span else None,
+        "events": _counts(events),
+        "health_events": [
+            {"anomaly": e.get("anomaly"), "step": e.get("step"),
+             "value": e.get("value"), "action": e.get("action")}
+            for e in health
+        ],
+        "hbm_peak_mb": hbm_peak,
+        "heartbeat": {
+            "phase": hb.get("phase"), "step": hb.get("step"),
+            "pid": hb.get("pid"), "beats": hb.get("beats"),
+            "age_s": round(hb_age, 1) if hb_age is not None else None,
+        } if hb else None,
+    }
+
+
+def _counts(events: list[dict]) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for e in events:
+        out[e.get("name", "?")] = out.get(e.get("name", "?"), 0) + 1
+    return out
+
+
+def _age(s: float) -> str:
+    if s < 120:
+        return f"{s:.0f}s"
+    if s < 7200:
+        return f"{s / 60:.0f}m"
+    if s < 48 * 3600:
+        return f"{s / 3600:.1f}h"
+    return f"{s / 86400:.1f}d"
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:.2f}"
+    return str(v)
+
+
+def render_markdown(d: dict) -> str:
+    if d["verdict"] == "empty":
+        return (f"## Run doctor — `{d['target']}`\n\n"
+                f"**verdict: empty** — {d['reason']}\n")
+    lines = [
+        f"## Run doctor — run `{d['run']}`",
+        "",
+        f"**verdict: {d['verdict']}** — {d['reason']}",
+        "",
+        f"`{d['telemetry']}` · {d['records']} records"
+        + (f" · {d['runs_in_file']} runs in file"
+           if d["runs_in_file"] > 1 else "")
+        + (f" · {d['bad_lines']} unparseable line(s)"
+           if d["bad_lines"] else ""),
+        "",
+        "| evidence | value |",
+        "|---|---|",
+        f"| last step | {_fmt(d['last_step'])} |",
+        f"| step spans | {d['steps']} |",
+    ]
+    st = d.get("step_time_ms")
+    if st:
+        lines.append(f"| step time p50 / p99 | {_fmt(st['p50'])} / "
+                     f"{_fmt(st['p99'])} ms |")
+    if d.get("stall"):
+        s = d["stall"]
+        lines.append(f"| stall | tail {s['tail_mean_ms']} ms vs p50 "
+                     f"{s['baseline_p50_ms']} ms ({s['ratio']}x) |")
+    ls = d.get("last_span")
+    if ls:
+        where = f" (step {ls['step']})" if ls.get("step") is not None else ""
+        lines.append(f"| last span | `{ls['name']}`{where}: "
+                     f"{_fmt(ls['dur_ms'])} ms |")
+    if d.get("hbm_peak_mb") is not None:
+        lines.append(f"| peak HBM | {_fmt(d['hbm_peak_mb'])} MB |")
+    hb = d.get("heartbeat")
+    if hb:
+        lines.append(
+            f"| heartbeat | phase {hb['phase']!r}, step {_fmt(hb['step'])}, "
+            f"pid {hb['pid']}, {hb['beats']} beats, "
+            f"age {_fmt(hb['age_s'])} s |"
+        )
+    else:
+        lines.append("| heartbeat | none for this run |")
+    if d.get("events"):
+        ev = ", ".join(f"{k}×{v}" for k, v in sorted(d["events"].items()))
+        lines.append(f"| events | {ev} |")
+    if d.get("health_events"):
+        lines += ["", "**Health events:**", ""]
+        for h in d["health_events"]:
+            lines.append(f"- step {h['step']}: `{h['anomaly']}` "
+                         f"value={h['value']} → {h['action']}")
+    return "\n".join(lines) + "\n"
+
+
+EXIT_BY_VERDICT = {"healthy": 0, "running": 0,
+                   "failed": 1, "crashed": 1, "hung": 1, "stalled": 1,
+                   "diverged": 1,
+                   "empty": 2}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="hyperion obs doctor",
+        description="classify a run (healthy/failed/crashed/hung/"
+                    "stalled/diverged) from its telemetry stream + "
+                    "heartbeat",
+    )
+    p.add_argument("target", help="run directory (containing "
+                                  "telemetry.jsonl) or a telemetry.jsonl")
+    p.add_argument("--run", default=None,
+                   help="run id to diagnose (default: last in stream)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--stale-s", type=float, default=STALE_S,
+                   help="heartbeat age beyond which a non-terminal run "
+                        "counts as hung")
+    p.add_argument("--now", type=float, default=None, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    tele, _ = locate(args.target)
+    if not tele.exists():
+        print(f"no telemetry stream at {tele}", file=sys.stderr)
+        return 2
+    d = diagnose(args.target, run=args.run, now=args.now,
+                 stale_s=args.stale_s)
+    if args.json:
+        print(json.dumps(d, indent=2, default=str))
+    else:
+        print(render_markdown(d), end="")
+    return EXIT_BY_VERDICT.get(d["verdict"], 2)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -59,7 +59,14 @@ def summarize(path: str | Path, run: str | None = None) -> dict:
     records = read_records(path)
     all_runs = runs(records)
     if not all_runs:
-        return {"path": str(path), "run": None, "error": "no records"}
+        return {"path": str(path), "run": None,
+                "error": "no parseable records in stream"}
+    if run is not None and run not in all_runs:
+        # a filtered-to-empty selection must fail loudly, not render an
+        # all-zero report that reads like a real (terrible) run
+        return {"path": str(path), "run": run,
+                "error": f"run {run!r} not in stream "
+                         f"({len(all_runs)} runs; see --list-runs)"}
     run = run or all_runs[-1]
     recs = [r for r in records if r.get("run") == run]
 
@@ -181,11 +188,28 @@ def render_markdown(s: dict) -> str:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    # doctor/diff own their full arg surfaces; dispatch before argparse
+    # so their --help stays theirs
+    if argv and argv[0] == "doctor":
+        from hyperion_tpu.obs.doctor import main as doctor_main
+
+        return doctor_main(argv[1:])
+    if argv and argv[0] == "diff":
+        from hyperion_tpu.obs.diff import main as diff_main
+
+        return diff_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="hyperion obs",
-        description="telemetry stream tools (obs/report.py)",
+        description="telemetry stream tools (obs/report.py); see also "
+                    "`obs doctor <dir>` and `obs diff <a> <b>`",
     )
     sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("doctor", help="classify a run (healthy/crashed/hung/"
+                                  "stalled/diverged) from telemetry + "
+                                  "heartbeat")
+    sub.add_parser("diff", help="compare two run summaries with a "
+                                "regression threshold")
     s = sub.add_parser("summarize", help="render a run summary from a "
                                          "telemetry JSONL")
     s.add_argument("telemetry", help="path to telemetry.jsonl")
@@ -205,11 +229,17 @@ def main(argv=None) -> int:
             print(r)
         return 0
     summary = summarize(args.telemetry, run=args.run)
+    if summary.get("error"):
+        # empty / filtered-to-empty: one line on stderr, nonzero exit —
+        # never a traceback, never an all-zero "report"
+        print(f"obs summarize: {args.telemetry}: {summary['error']}",
+              file=sys.stderr)
+        return 1
     if args.json:
         print(json.dumps(summary, indent=2, default=str))
     else:
         print(render_markdown(summary), end="")
-    return 0 if not summary.get("error") else 1
+    return 0
 
 
 if __name__ == "__main__":

@@ -91,6 +91,14 @@ DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_KV = 1024
 LANES = 128     # lane-broadcast width for per-row stats (lse/delta)
 SUBLANES = 8    # sublane-broadcast height for the padding mask
+# Performance-relevant revision of this kernel pair, stamped into every
+# attention_bench CSV row so offline readers (compare_to_reference.py's
+# auto-picks column) can tell a capture of THIS kernel from a stale one.
+# Bump on any change that moves the measured xla/pallas crossover:
+#   rev 2 — input-dtype MXU feeds (was fp32-cast 6-pass) + 1024x1024
+#           tiles (was 128x128); the committed pre-fix capture carries
+#           no rev column at all.
+KERNEL_REV = 2
 
 
 def default_blocks(head_dim: int) -> tuple[int, int]:

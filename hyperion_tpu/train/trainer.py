@@ -59,7 +59,9 @@ from hyperion_tpu.obs import (
     observe_step,
     observe_throughput,
 )
+from hyperion_tpu.obs import heartbeat as obs_heartbeat
 from hyperion_tpu.obs import trace as obs_trace
+from hyperion_tpu.obs.health import HealthConfig, HealthMonitor
 from hyperion_tpu.models.transformer_lm import TransformerLM, simple_lm_config
 from hyperion_tpu.parallel.partition import TRANSFORMER_TP_RULES
 from hyperion_tpu.precision.policy import get_policy
@@ -167,6 +169,34 @@ def _save_checkpoint(ckpt_dir: str, state, tag: str) -> None:
     dist.host_barrier(f"post_ckpt_{tag}", timeout_s=3600.0)
 
 
+def _health_react(
+    job: str, action: str, monitor: HealthMonitor, state, ckpt_dir,
+    tracer,
+) -> bool:
+    """React to a HealthMonitor escalation; True means abort the run.
+
+    `warn` prints (primary only — the event is already in the trace);
+    `checkpoint` saves a step-tagged snapshot and continues — evidence
+    preservation for statistical anomalies (spikes/explosions), where
+    the state is still finite. If ANY anomaly fired this step is fatal,
+    nothing saves: the optimizer already applied the non-finite update,
+    and a poisoned tree must not become the newest checkpoint `restore`
+    would pick — a fatal can co-fire with a non-fatal on one step, so
+    the whole fired batch is inspected, not just the last anomaly."""
+    fired = monitor.last_escalated or monitor.anomalies[-1:]
+    if dist.is_primary():
+        for anom in fired:
+            print(f"[{job}] health[{action}]: {anom.kind} at step "
+                  f"{anom.step} (value {anom.value}"
+                  f"{', ' + str(anom.detail) if anom.detail else ''})")
+    if action == "checkpoint" and ckpt_dir \
+            and not any(a.fatal for a in fired):
+        anom = fired[-1]
+        with tracer.span("checkpoint", reason=f"health_{anom.kind}"):
+            _save_checkpoint(ckpt_dir, state, f"health_{anom.step}")
+    return action == "abort"
+
+
 def _epoch_loop(
     *,
     job: str,
@@ -199,6 +229,22 @@ def _epoch_loop(
     # instrumentation adds no sync inside the step loop.
     tracer = tracer or obs_trace.null_tracer()
     reg = MetricsRegistry()
+    # Flight recorder + in-band health (obs/): the heartbeat is host
+    # file IO riding the tracer's enablement (rank-0 only, like the
+    # CSV); the monitor consumes python floats only — neither can add a
+    # device sync to the step loop (obs/health.py's sync discipline).
+    hb = obs_heartbeat.Heartbeat.for_tracer(
+        tracer, every=cfg.train.heartbeat_every or 25)
+    monitor = (
+        HealthMonitor(HealthConfig(policy=cfg.train.health_policy),
+                      tracer=tracer)
+        if cfg.train.health_policy != "off" else None
+    )
+    # first pulse BEFORE any device work: the dominant hang window on
+    # this deployment is backend init + the first step's compile, and a
+    # watcher must see "a trainer is alive in init" during it — the
+    # first step-loop beat can be minutes away
+    hb.pulse(step=resume_step, phase="init", epoch=resume_epoch + 1)
     steps_per_epoch = _steps_per_epoch(cfg, batches)
     # what one step processes, for the throughput gauges (LM jobs count
     # tokens; cifar counts images)
@@ -216,6 +262,22 @@ def _epoch_loop(
     max_steps = cfg.train.steps_per_epoch or None
     guard = guard if guard is not None else PreemptionGuard()
     n_proc = dist.process_count()
+
+    def abort_exit(epoch: int, n_steps: int):
+        """Common exit for a health-policy abort: the trace gets the
+        abort event + anomaly tally, the heartbeat its terminal phase,
+        and the caller a truthy third element so final exports are
+        skipped exactly like a preemption (a diverged tree must never
+        clobber a previous good export)."""
+        tracer.event("health_abort", epoch=epoch, steps_done=n_steps,
+                     **monitor.summary())
+        hb.close(phase="aborted")
+        if dist.is_primary():
+            print(f"[{job}] health policy ABORTED the run at global step "
+                  f"{int(state.step)} (epoch {epoch}); exports skipped — "
+                  "the last epoch-boundary checkpoint is the last good "
+                  "state")
+        return state, history, "health_abort"
 
     def stop_requested() -> bool:
         # Single-process (every single-host run, and this repo's bench
@@ -242,6 +304,7 @@ def _epoch_loop(
             # epoch skips its already-trained prefix
             start = resume_step if epoch == resume_epoch else 0
             stopping = False
+            aborting = False
             # --profile-dir: capture a jax.profiler trace of the FIRST
             # epoch this run executes (SURVEY §5.1's idiomatic upgrade)
             profile_this = cfg.train.profile_dir and epoch == resume_epoch
@@ -278,6 +341,31 @@ def _epoch_loop(
                     # sp.dur_s is dispatch time; the throughput GAUGES
                     # are set from the fenced epoch duration below
                     observe_step(reg, sp.dur_s, **thru_kw)
+                    gstep = epoch * steps_per_epoch + i
+                    if cfg.train.heartbeat_every:
+                        hb.beat(step=gstep, phase="train", epoch=epoch + 1)
+                    if monitor is not None:
+                        # loss/grad_norm feed the monitor ONLY where the
+                        # loop already fenced this step (the CPU test
+                        # mesh): float() there reads a ready host
+                        # buffer. On lazy backends they stay on device
+                        # — the epoch-end check below covers non-finite
+                        # divergence from the already-fetched mean.
+                        # Step time is host-side either way.
+                        action = monitor.observe_step(
+                            gstep,
+                            loss=(float(metrics["loss"])
+                                  if fence_every_step else None),
+                            grad_norm=(float(metrics["grad_norm"])
+                                       if fence_every_step
+                                       and "grad_norm" in metrics else None),
+                            step_time_s=sp.dur_s,
+                        )
+                        if action != "none" and _health_react(
+                            job, action, monitor, state, ckpt_dir, tracer
+                        ):
+                            aborting = True
+                            break
                 # host-fetch fence: on the axon backend block_until_ready
                 # can return before execution, so fetch a scalar of the
                 # last step's metrics (which depends, through the state
@@ -312,6 +400,8 @@ def _epoch_loop(
                     reg, step=epoch * steps_per_epoch + len(device_metrics)
                     + start, epoch=epoch + 1,
                 )
+            if aborting:
+                return abort_exit(epoch + 1, len(device_metrics))
             planned = steps_per_epoch - start
             if stopping and len(device_metrics) < planned:
                 # cut short mid-epoch: the state holds every COMPLETED
@@ -323,6 +413,7 @@ def _epoch_loop(
                 # row, validation, and epoch-boundary save first.)
                 tracer.event("preempted", epoch=epoch + 1, mid_epoch=True,
                              steps_done=len(device_metrics))
+                hb.close(phase="preempted")
                 if ckpt_dir:
                     _save_checkpoint(ckpt_dir, state, f"preempt_{epoch}")
                 if dist.is_primary():
@@ -332,11 +423,31 @@ def _epoch_loop(
                              if ckpt_dir else "no checkpoint dir — state lost"))
                 return state, history, True
             loss = _mean_of(device_metrics, "loss")
+            if monitor is not None and not fence_every_step and device_metrics:
+                # lazy backends: per-step scalars stayed on device, so
+                # judge the epoch mean — already fetched for the CSV
+                # row, so this adds zero fetches. A NaN anywhere in the
+                # epoch poisons the mean; divergence is caught one
+                # epoch late at worst.
+                action = monitor.observe_epoch(
+                    epoch + 1,
+                    epoch * steps_per_epoch + start + len(device_metrics),
+                    loss)
+                if action != "none" and _health_react(
+                    job, action, monitor, state, ckpt_dir, tracer
+                ):
+                    return abort_exit(epoch + 1, len(device_metrics))
             extra = extra_cols(device_metrics) if extra_cols else {}
             if eval_step is not None and eval_batches is not None:
                 # validation pass (exceeds the reference, which never
                 # evaluated): deterministic order, no dropout, no grads
                 val_metrics = []
+                # step from host-side counters, NOT int(state.step):
+                # that would be a device fetch a disabled heartbeat
+                # still pays
+                hb.pulse(step=epoch * steps_per_epoch + start
+                         + len(device_metrics), phase="eval",
+                         epoch=epoch + 1)
                 with tracer.span("eval") as ev_span:
                     for i, vbatch in enumerate(eval_batches.epoch(0)):
                         if max_steps and i >= max_steps:
@@ -370,6 +481,9 @@ def _epoch_loop(
                     f"loss={loss:.4f}{extras} ({duration:.2f}s)"
                 )
             if ckpt_dir:
+                hb.pulse(step=epoch * steps_per_epoch + start
+                         + len(device_metrics), phase="checkpoint",
+                         epoch=epoch + 1)
                 with tracer.span("checkpoint", epoch=epoch + 1):
                     _save_checkpoint(ckpt_dir, state, str(epoch))
             if stopping:
@@ -377,10 +491,12 @@ def _epoch_loop(
                 # trained, logged, and saved above — stop before starting
                 # the next one. Resume continues at the next epoch.
                 tracer.event("preempted", epoch=epoch + 1, mid_epoch=False)
+                hb.close(phase="preempted")
                 if dist.is_primary():
                     print(f"[{job}] preempted at epoch boundary "
                           f"{epoch + 1}/{cfg.train.epochs}; rerun to resume")
                 return state, history, True
+    hb.close(phase="done")
     return state, history, False
 
 
@@ -1126,12 +1242,16 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
         path.write_text(_json.dumps(summary, indent=2))
         print(f"[{job}] summary: {_json.dumps(summary)}")
 
-    # save_pretrained analogue: adapters alone for LoRA, else full params
-    export = state.params["lora"] if cfg.train.lora else state.params
-    ckpt.export_gathered(
-        f"{cfg.train.base_dir}/checkpoints/{job}_{mode}_final.npz", export
-    )
-    if cfg.train.lora and cfg.train.export_merged:
+    # save_pretrained analogue: adapters alone for LoRA, else full params.
+    # A preempted run still exports (the tree is merely early-stopped);
+    # a health-aborted one must not — the params are non-finite.
+    if preempted != "health_abort":
+        export = state.params["lora"] if cfg.train.lora else state.params
+        ckpt.export_gathered(
+            f"{cfg.train.base_dir}/checkpoints/{job}_{mode}_final.npz", export
+        )
+    if (cfg.train.lora and cfg.train.export_merged
+            and preempted != "health_abort"):
         # base+adapters folded into plain Llama params: what the
         # generation CLI loads. Opt-in (--export-merged): gathering the
         # base doubles export cost, which 7B capture runs don't want.

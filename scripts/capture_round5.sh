@@ -27,6 +27,11 @@ export HYPERION_BENCH_EXTRA_TIMEOUT="${HYPERION_BENCH_EXTRA_TIMEOUT:-900}"
 # unknown outer limit) plus a third probe retry
 export HYPERION_BENCH_DEADLINE="${HYPERION_BENCH_DEADLINE:-1500}"
 export HYPERION_BENCH_PROBE_RETRIES="${HYPERION_BENCH_PROBE_RETRIES:-3}"
+# telemetry + heartbeat for every stage (bench/infer are opt-in by
+# default): tpu_watch.sh reads the heartbeat files to tell a slow stage
+# from a hung one before re-firing, and `obs doctor` post-mortems any
+# stage the window kills
+export HYPERION_TELEMETRY="${HYPERION_TELEMETRY:-1}"
 
 commit() {  # commit <msg> <paths...> — retries around concurrent commits
   local msg="$1"; shift

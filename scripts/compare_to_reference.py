@@ -196,6 +196,30 @@ def attention_table(root: Path) -> None:
     if not rows:
         print("(attention/attention_scaling.csv not captured yet)\n")
         return
+    # Staleness gate (ADVICE r5): the committed capture predates the
+    # kernel dtype/tile fixes (no kernel_rev column — new captures stamp
+    # flash_attention.KERNEL_REV per row). Judging today's selection
+    # table against yesterday's kernel would print "(MISMATCH)" on every
+    # long-seq row and read as "auto is mistuned"; on a stale capture
+    # the auto pick is shown without the verdict, with a caveat line.
+    try:
+        from hyperion_tpu.ops.pallas.flash_attention import KERNEL_REV
+    except Exception:  # noqa: BLE001 — table must render without jax
+        KERNEL_REV = None
+    csv_rev = None
+    for r in rows:
+        try:
+            csv_rev = int(r["kernel_rev"])
+            break
+        except (KeyError, TypeError, ValueError):
+            continue
+    stale = KERNEL_REV is not None and (csv_rev is None or csv_rev < KERNEL_REV)
+    if stale:
+        print(f"> **stale capture:** rows predate kernel rev {KERNEL_REV} "
+              f"(CSV rev: {csv_rev if csv_rev is not None else 'none'}) — "
+              "measured xla/pallas winners reflect the OLD kernel, so the "
+              "auto-pick column is shown without a MISMATCH verdict until "
+              "the re-capture lands\n")
     # geometry column is absent in pre-r4b captures: default to gpt2
     geos = sorted({r.get("geometry") or "gpt2" for r in rows})
     by_key = {
@@ -247,7 +271,7 @@ def attention_table(root: Path) -> None:
                                  {"gpt2": 64, "llama": 128}.get(geo, 64))
                         pick = select_attention_impl(int(seq), hd, mode=mode)
                         picked_row = {"xla": xla, "pallas": pl}.get(pick)
-                        if ratio is not None:
+                        if ratio is not None and not stale:
                             # raw ratio, not the rounded display string:
                             # a 1.004 near-tie must not flip the verdict
                             faster = "pallas" if ratio > 1.0 else "xla"

@@ -22,9 +22,51 @@ busy() {
   [ -f "$f" ] && [ $(( $(date +%s) - $(stat -c %Y "$f") )) -lt 2700 ]
 }
 
+alive_heartbeat() {
+  # Hung-vs-slow discrimination (obs/heartbeat.py): every instrumented
+  # stage rewrites a heartbeat.json as it progresses. A FRESH beat
+  # under results/ means some stage process is alive and moving —
+  # re-running on top of it would double-book the chip and measure
+  # contention; only a STALE (or absent) heartbeat clears the watcher
+  # to (re)fire a capture. The threshold must exceed the longest a
+  # bench parent legitimately blocks without pulsing: capture_round5
+  # exports HYPERION_BENCH_EXTRA_TIMEOUT=900, so default to 1200 for
+  # margin (children have also been observed to outlive SIGTERM).
+  HEARTBEAT_FRESH_S="${HEARTBEAT_FRESH_S:-1200}" python - <<'PY'
+import json, os, sys, time
+from pathlib import Path
+fresh_s = float(os.environ["HEARTBEAT_FRESH_S"])
+newest = None
+root = Path("results")
+for p in (root.rglob("heartbeat.json") if root.is_dir() else ()):
+    try:
+        hb = json.loads(p.read_text())
+        age = time.time() - float(hb["t_wall"])
+    except Exception:
+        continue
+    if hb.get("phase") in ("done", "aborted", "preempted"):
+        continue  # terminal phases mean the process said goodbye
+    if newest is None or age < newest[0]:
+        newest = (age, str(p), hb.get("phase"), hb.get("step"))
+if newest and newest[0] < fresh_s:
+    age, path, phase, step = newest
+    print(f"[watch] live heartbeat {path} (phase {phase!r}, step {step}, "
+          f"age {age:.0f}s)")
+    sys.exit(0)
+sys.exit(1)
+PY
+}
+
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if busy; then
     echo "[watch] host busy (results/.host_busy); deferring probe 120s"
+    sleep 120
+    continue
+  fi
+  if alive_heartbeat; then
+    # a stage is slow, not hung — re-running it now is the old failure
+    # mode this file exists to prevent
+    echo "[watch] stage still progressing; deferring probe 120s"
     sleep 120
     continue
   fi

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Regenerate the golden telemetry fixtures (run from the repo root):
+
+    python tests/data/telemetry/gen_fixtures.py
+
+Four run directories, one per failure mode `obs doctor` must classify
+(tests/test_obs_doctor.py asserts the verdicts; the schema contract
+test asserts the record fields stay stable):
+
+    healthy/  — full run, terminal `train_end`, heartbeat phase "done"
+    nan/      — loss goes NaN mid-run; real HealthMonitor under the
+                `abort` policy emits the `health` event + abort trail
+    stalled/  — tail steps ~50x slower than the run's own p50; no
+                terminal event. Classified "stalled" only from a fresh
+                vantage (`--now` near its heartbeat — the loop is alive
+                and degrading); against real time the same stream is
+                "hung", staleness outranking the stall pattern
+    crashed/  — stream ends mid-record (the killed-process signature);
+                heartbeat frozen in phase "train"
+
+Everything is driven by fake clocks pinned to _WALL0 so the files are
+byte-stable across regenerations (no real time leaks in). The committed
+wall timestamps are intentionally in the past: doctor's staleness rules
+must hold against real `time.time()` too, which is exactly how the
+tier-1 smoke test runs it.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[3]))
+
+from hyperion_tpu.obs.health import HealthConfig, HealthMonitor  # noqa: E402
+from hyperion_tpu.obs.heartbeat import Heartbeat  # noqa: E402
+from hyperion_tpu.obs.registry import MetricsRegistry  # noqa: E402
+from hyperion_tpu.obs.trace import Tracer  # noqa: E402
+
+_WALL0 = 1754000000.0  # 2026-07-31T21:33:20Z — fixed so fixtures are stable
+_OUT = Path(__file__).resolve().parent
+
+
+class Clock:
+    def __init__(self, t: float):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def _setup(name: str, run: str):
+    d = _OUT / name
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "telemetry.jsonl").unlink(missing_ok=True)
+    clk, wall = Clock(100.0), Clock(_WALL0)
+    t = Tracer(d / "telemetry.jsonl", run=run, proc=0, clock=clk, wall=wall)
+    hb = Heartbeat(d / "heartbeat.json", run=run, proc=0, every=1,
+                   clock=clk, wall=wall)
+    return d, t, hb, clk, wall
+
+
+def _snapshot(t: Tracer, step: int, tokens_per_s: float = 4096.0):
+    reg = MetricsRegistry()
+    reg.counter("steps").inc(step)
+    reg.gauge("tokens_per_s").set(tokens_per_s)
+    reg.gauge("step_time_ema_ms").set(10.0)
+    reg.gauge("mfu").set(0.31)
+    reg.gauge("hbm_peak_mb").set(900.0)
+    reg.histogram("step_time_ms").observe(10.0)
+    reg.set_label("mfu_peak_source", "nominal")
+    t.snapshot(reg, step=step, epoch=1)
+
+
+def _steps(t: Tracer, hb: Heartbeat, clk, wall, durs_ms, start=0):
+    for i, ms in enumerate(durs_ms, start):
+        with t.span("train_step", step=i):
+            clk.advance(ms / 1e3)
+            wall.advance(ms / 1e3)
+        hb.beat(step=i, phase="train", epoch=1)
+
+
+def healthy():
+    d, t, hb, clk, wall = _setup("healthy", "fix_healthy")
+    t.event("train_start", job="language_ddp", n_devices=8, epochs=1)
+    with t.span("epoch", step=0) as ep:
+        _steps(t, hb, clk, wall, [10.0] * 8)
+        ep.set(epoch=1, steps=8)
+    _snapshot(t, 8)
+    with t.span("checkpoint", epoch=1):
+        clk.advance(0.2)
+        wall.advance(0.2)
+    hb.pulse(step=8, phase="checkpoint", epoch=1)
+    t.event("train_end", preempted=False, epochs_run=1)
+    hb.close(phase="done")
+    t.close()
+
+
+def nan():
+    d, t, hb, clk, wall = _setup("nan", "fix_nan")
+    mon = HealthMonitor(HealthConfig(policy="abort"), tracer=t)
+    t.event("train_start", job="language_ddp", n_devices=8, epochs=1)
+    losses = [4.0, 3.8, 3.7, 3.6, 3.9, float("nan")]
+    aborted_at = None
+    with t.span("epoch", step=0) as ep:
+        for i, loss in enumerate(losses):
+            with t.span("train_step", step=i):
+                clk.advance(0.010)
+                wall.advance(0.010)
+            hb.beat(step=i, phase="train", epoch=1)
+            action = mon.observe_step(i, loss=loss, grad_norm=1.0,
+                                      step_time_s=0.010)
+            if action == "abort":
+                aborted_at = i
+                break
+        ep.set(epoch=1, steps=aborted_at + 1)
+    assert aborted_at is not None, "fixture must abort on the NaN"
+    t.event("health_abort", epoch=1, steps_done=aborted_at + 1,
+            **mon.summary())
+    t.event("train_end", preempted="health_abort", epochs_run=0)
+    hb.close(phase="aborted")
+    t.close()
+
+
+def stalled():
+    d, t, hb, clk, wall = _setup("stalled", "fix_stalled")
+    t.event("train_start", job="language_ddp", n_devices=8, epochs=1)
+    # the epoch span never closes: the run was still inside it
+    t._stack.append("epoch")
+    _steps(t, hb, clk, wall, [10.0] * 8 + [500.0, 520.0, 540.0])
+    t.flush()
+    t.close()
+
+
+def hung():
+    d, t, hb, clk, wall = _setup("hung", "fix_hung")
+    t.event("train_start", job="language_ddp", n_devices=8, epochs=1)
+    t._stack.append("epoch")
+    _steps(t, hb, clk, wall, [10.0] * 6)
+    t.flush()
+    t.close()
+    # the heartbeat froze in phase "train" — wall-clock staleness (vs a
+    # real `now`) is the only evidence, which is the point of the file
+
+
+def crashed():
+    d, t, hb, clk, wall = _setup("crashed", "fix_crashed")
+    t.event("train_start", job="language_ddp", n_devices=8, epochs=1)
+    t._stack.append("epoch")
+    _steps(t, hb, clk, wall, [10.0] * 5)
+    t.flush()
+    t.close()
+    # SIGKILL mid-record: the stream's last line is a fragment a reader
+    # must survive AND a doctor must recognize as the crash signature
+    with (d / "telemetry.jsonl").open("a", encoding="utf-8") as f:
+        f.write('{"v":1,"kind":"span","name":"train_step","run":"fix_crash')
+
+
+def main() -> int:
+    for fn in (healthy, nan, stalled, hung, crashed):
+        fn()
+        print(f"wrote {fn.__name__}/")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

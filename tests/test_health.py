@@ -1,0 +1,346 @@
+"""obs/health + obs/heartbeat — the watchdog half of the telemetry layer.
+
+Unit tests are device-free (the monitor consumes python floats by
+contract; the heartbeat is pure file IO). The trainer integration tests
+run the real language driver on the simulated mesh: a diverging run
+under the `abort` policy must stop, record the `health` event in
+telemetry.jsonl, and skip exports — and instrumentation must add ZERO
+host fences inside the step loop (counted the same way the epoch
+boundary's one honest fence is counted).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from hyperion_tpu.obs.health import (
+    ACTIONS,
+    HealthConfig,
+    HealthMonitor,
+    worst,
+)
+from hyperion_tpu.obs.heartbeat import (
+    Heartbeat,
+    heartbeat_age_s,
+    null_heartbeat,
+    read_heartbeat,
+)
+from hyperion_tpu.obs.trace import Tracer
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+class TestHealthMonitor:
+    def test_quiet_run_stays_quiet(self):
+        mon = HealthMonitor(HealthConfig(policy="abort"))
+        for i in range(100):
+            assert mon.observe_step(i, loss=4.0 - i * 0.01, grad_norm=1.0,
+                                    step_time_s=0.01) == "none"
+        assert mon.anomalies == []
+
+    @pytest.mark.parametrize("bad", [float("nan"), float("inf"),
+                                     float("-inf")])
+    def test_nonfinite_loss_is_fatal(self, bad):
+        mon = HealthMonitor(HealthConfig(policy="abort"))
+        assert mon.observe_step(0, loss=4.0) == "none"
+        assert mon.observe_step(1, loss=bad) == "abort"
+        (anom,) = mon.anomalies
+        assert anom.kind == "nonfinite_loss" and anom.fatal
+
+    def test_nonfinite_grad_is_fatal(self):
+        mon = HealthMonitor(HealthConfig(policy="abort"))
+        assert mon.observe_step(0, grad_norm=float("nan")) == "abort"
+        assert mon.anomalies[0].kind == "nonfinite_grad"
+
+    def test_policy_caps_fatal_action(self):
+        for policy, expect in [("warn", "warn"), ("checkpoint", "checkpoint"),
+                               ("abort", "abort")]:
+            mon = HealthMonitor(HealthConfig(policy=policy))
+            assert mon.observe_step(0, loss=float("nan")) == expect
+        assert HealthMonitor(HealthConfig(policy="off")).observe_step(
+            0, loss=float("nan")) == "none"
+
+    def test_loss_spike_z_score(self):
+        cfg = HealthConfig(policy="abort", min_window=8)
+        mon = HealthMonitor(cfg)
+        rng = np.random.default_rng(0)
+        for i in range(32):  # noisy but sane window
+            assert mon.observe_step(
+                i, loss=4.0 + 0.05 * float(rng.standard_normal())) == "none"
+        # a 100x jump is a spike; statistical anomalies cap below abort
+        action = mon.observe_step(32, loss=400.0)
+        assert action == "checkpoint"  # capped: never aborts on a spike
+        assert mon.anomalies[-1].kind == "loss_spike"
+        assert not mon.anomalies[-1].fatal
+
+    def test_spike_on_flat_window_uses_relative_jump(self):
+        mon = HealthMonitor(HealthConfig(policy="warn", min_window=4))
+        for i in range(8):
+            mon.observe_step(i, loss=1.0)  # zero-variance window
+        assert mon.observe_step(8, loss=50.0) == "warn"
+        assert mon.anomalies[-1].kind == "loss_spike"
+
+    def test_grad_explosion(self):
+        mon = HealthMonitor(HealthConfig(policy="warn", min_window=4))
+        for i in range(16):
+            assert mon.observe_step(i, grad_norm=1.0 + 0.01 * i) == "none"
+        assert mon.observe_step(16, grad_norm=100.0) == "warn"
+        assert mon.anomalies[-1].kind == "grad_explosion"
+
+    def test_step_stall_vs_ema(self):
+        mon = HealthMonitor(HealthConfig(policy="warn", min_window=4))
+        for i in range(16):
+            assert mon.observe_step(i, step_time_s=0.01) == "none"
+        assert mon.observe_step(16, step_time_s=1.0) == "warn"
+        assert mon.anomalies[-1].kind == "step_stall"
+
+    def test_step_stall_caps_at_warn_even_under_checkpoint_policy(self):
+        # step time is the one HOST-LOCAL signal (loss/grads are
+        # replicated): a stall must never trigger the barrier-fenced
+        # checkpoint path, or one host of a multi-host run enters the
+        # barrier while its peers keep training
+        mon = HealthMonitor(HealthConfig(policy="checkpoint",
+                                         min_window=4))
+        for i in range(16):
+            mon.observe_step(i, step_time_s=0.01)
+        assert mon.observe_step(16, step_time_s=1.0) == "warn"
+
+    def test_cofired_fatal_and_stall_expose_the_fatal(self):
+        # one step can fire a non-fatal stall AND a fatal NaN together;
+        # last_escalated carries the whole batch so a caller gating a
+        # checkpoint on "not fatal" cannot be fooled by anomalies[-1]
+        mon = HealthMonitor(HealthConfig(policy="checkpoint",
+                                         min_window=2))
+        for i in range(8):
+            mon.observe_step(i, loss=1.0, step_time_s=0.01)
+        action = mon.observe_step(8, loss=float("nan"), step_time_s=1.0)
+        assert action == "checkpoint"  # fatal capped by the policy
+        kinds = {a.kind for a in mon.last_escalated}
+        assert kinds == {"nonfinite_loss", "step_stall"}
+        assert any(a.fatal for a in mon.last_escalated)
+
+    def test_cooldown_rate_limits_repeats(self):
+        mon = HealthMonitor(HealthConfig(policy="warn", cooldown_steps=10))
+        assert mon.observe_step(0, loss=float("nan")) == "warn"
+        # a NaN-every-step run must not log one event per step
+        for i in range(1, 10):
+            assert mon.observe_step(i, loss=float("nan")) == "none"
+        assert mon.observe_step(10, loss=float("nan")) == "warn"
+        assert len(mon.anomalies) == 2
+
+    def test_events_land_in_trace_with_anomaly_field(self, tmp_path):
+        t = Tracer(tmp_path / "t.jsonl", run="r", proc=0)
+        mon = HealthMonitor(HealthConfig(policy="abort"), tracer=t)
+        mon.observe_step(7, loss=float("nan"))
+        t.close()
+        (rec,) = [json.loads(line)
+                  for line in (tmp_path / "t.jsonl").read_text().splitlines()]
+        assert rec["kind"] == "event" and rec["name"] == "health"
+        # "kind" is a reserved tracer key — the anomaly class must
+        # survive under its own field
+        assert rec["anomaly"] == "nonfinite_loss"
+        assert rec["step"] == 7 and rec["fatal"] is True
+        assert rec["action"] == "abort"
+
+    def test_epoch_granularity_check(self):
+        mon = HealthMonitor(HealthConfig(policy="abort"))
+        assert mon.observe_epoch(1, 100, 4.0) == "none"
+        assert mon.observe_epoch(2, 200, float("nan")) == "abort"
+
+    def test_summary_tallies(self):
+        mon = HealthMonitor(HealthConfig(policy="warn", cooldown_steps=1))
+        mon.observe_step(0, loss=float("nan"))
+        mon.observe_step(1, loss=float("nan"))
+        s = mon.summary()
+        assert s["anomalies"] == {"nonfinite_loss": 2}
+        assert s["fatal"] == 2 and s["steps_observed"] == 2
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(ValueError, match="policy"):
+            HealthConfig(policy="explode")
+
+    def test_worst_ordering(self):
+        assert worst("none", "warn") == "warn"
+        assert worst("abort", "checkpoint") == "abort"
+        assert list(ACTIONS) == ["none", "warn", "checkpoint", "abort"]
+
+
+class TestHeartbeat:
+    def make(self, tmp_path, **kw):
+        clk, wall = FakeClock(100.0), FakeClock(1_000_000.0)
+        kw.setdefault("every", 5)
+        hb = Heartbeat(tmp_path / "heartbeat.json", run="r1", proc=2,
+                       clock=clk, wall=wall, **kw)
+        return hb, clk, wall
+
+    def test_pulse_writes_schema(self, tmp_path):
+        hb, _, _ = self.make(tmp_path)
+        hb.pulse(step=3, phase="train", epoch=1)
+        rec = read_heartbeat(tmp_path / "heartbeat.json")
+        assert rec["v"] == 1 and rec["run"] == "r1" and rec["proc"] == 2
+        assert rec["step"] == 3 and rec["phase"] == "train"
+        assert rec["epoch"] == 1 and rec["beats"] == 1
+        assert isinstance(rec["pid"], int)
+        assert rec["t_wall"] == 1_000_000.0 and rec["t_mono"] == 100.0
+        # atomic replace leaves no temp litter
+        assert list(tmp_path.iterdir()) == [tmp_path / "heartbeat.json"]
+
+    def test_beat_rate_limited_by_steps(self, tmp_path):
+        hb, _, _ = self.make(tmp_path, every=5)
+        for i in range(12):
+            hb.beat(step=i, phase="train")
+        rec = read_heartbeat(tmp_path / "heartbeat.json")
+        # writes at steps 0, 5, 10 — not 12 times
+        assert rec["step"] == 10 and rec["beats"] == 3
+
+    def test_beat_fires_on_elapsed_time_despite_slow_steps(self, tmp_path):
+        hb, clk, _ = self.make(tmp_path, every=1000, interval_s=15.0)
+        hb.beat(step=0, phase="train")
+        clk.advance(20.0)  # one slow step, far under the step cadence
+        hb.beat(step=1, phase="train")
+        assert read_heartbeat(tmp_path / "heartbeat.json")["step"] == 1
+
+    def test_beat_fires_on_phase_change(self, tmp_path):
+        hb, _, _ = self.make(tmp_path, every=1000)
+        hb.beat(step=0, phase="train")
+        hb.beat(step=1, phase="eval")
+        rec = read_heartbeat(tmp_path / "heartbeat.json")
+        assert rec["phase"] == "eval" and rec["beats"] == 2
+
+    def test_close_records_terminal_phase(self, tmp_path):
+        hb, _, _ = self.make(tmp_path)
+        hb.beat(step=9, phase="train")
+        hb.close(phase="done")
+        rec = read_heartbeat(tmp_path / "heartbeat.json")
+        assert rec["phase"] == "done" and rec["step"] == 9
+
+    def test_null_heartbeat_noops(self, tmp_path):
+        hb = null_heartbeat()
+        hb.beat(step=0, phase="train")
+        hb.pulse(phase="x")
+        hb.close()
+        assert not hb.enabled
+
+    def test_read_missing_or_corrupt_is_none(self, tmp_path):
+        assert read_heartbeat(tmp_path / "nope.json") is None
+        (tmp_path / "torn.json").write_text('{"v": 1, "run"')
+        assert read_heartbeat(tmp_path / "torn.json") is None
+
+    def test_age_math(self):
+        assert heartbeat_age_s({"t_wall": 100.0}, now=160.0) == 60.0
+        assert heartbeat_age_s({}, now=160.0) is None
+
+    def test_for_tracer_policy(self, tmp_path, monkeypatch):
+        from hyperion_tpu.obs import heartbeat as hb_mod
+        from hyperion_tpu.obs.trace import null_tracer
+
+        t = Tracer(tmp_path / "telemetry.jsonl", run="r9", proc=1)
+        hb = Heartbeat.for_tracer(t)
+        assert hb.enabled and hb.run == "r9" and hb.proc == 1
+        assert hb.path == tmp_path / "heartbeat.json"
+        assert not Heartbeat.for_tracer(null_tracer()).enabled
+        monkeypatch.setenv(hb_mod.ENV_VAR, "0")
+        assert not Heartbeat.for_tracer(t).enabled
+        monkeypatch.setenv(hb_mod.ENV_VAR, str(tmp_path / "elsewhere.json"))
+        hb = Heartbeat.for_tracer(null_tracer())
+        assert hb.enabled and hb.path == tmp_path / "elsewhere.json"
+
+
+def _train_cfg(tmp_path, **over):
+    from hyperion_tpu.config import Config
+
+    cfg = Config()
+    cfg.train.epochs = 1
+    cfg.train.batch_size = 16
+    cfg.train.seq_len = 16
+    cfg.train.steps_per_epoch = 6
+    cfg.train.base_dir = str(tmp_path)
+    cfg.train.validate = False
+    cfg.train.learning_rate = 1e-2
+    for k, v in over.items():
+        setattr(cfg.train, k, v)
+    return cfg
+
+
+class TestTrainerIntegration:
+    def test_abort_policy_stops_diverged_run(self, tmp_path, mesh_dp):
+        from hyperion_tpu.train.trainer import train_language_model
+
+        # lr=1e30 is the divergence injection: step 0 trains, the
+        # update overflows the params, step 1's loss is non-finite
+        cfg = _train_cfg(tmp_path, learning_rate=1e30,
+                         health_policy="abort")
+        res = train_language_model(cfg)
+        assert res.history == []  # the epoch never completed
+        # no export: a poisoned tree must not become *_final.npz
+        assert not (tmp_path / "checkpoints"
+                    / "language_ddp_final.npz").exists()
+        # the health event and the abort trail are in the stream
+        recs = [json.loads(line) for line in
+                (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+        health = [r for r in recs if r.get("name") == "health"]
+        assert health and health[0]["anomaly"] == "nonfinite_loss"
+        assert health[0]["fatal"] is True
+        names = {r.get("name") for r in recs}
+        assert "health_abort" in names
+        end = [r for r in recs if r.get("name") == "train_end"]
+        assert end and end[0]["preempted"] == "health_abort"
+        # heartbeat froze in its terminal phase
+        hb = read_heartbeat(tmp_path / "heartbeat.json")
+        assert hb is not None and hb["phase"] == "aborted"
+        # and the doctor reads the post-mortem as divergence
+        from hyperion_tpu.obs.doctor import diagnose
+
+        d = diagnose(tmp_path)
+        assert d["verdict"] == "diverged"
+
+    def test_healthy_run_zero_added_fences_and_heartbeat(
+        self, tmp_path, mesh_dp, monkeypatch
+    ):
+        import hyperion_tpu.train.trainer as trainer_mod
+
+        calls = {"n": 0}
+        real_fence = trainer_mod.host_fence
+
+        def counting_fence(tree):
+            calls["n"] += 1
+            return real_fence(tree)
+
+        monkeypatch.setattr(trainer_mod, "host_fence", counting_fence)
+        cfg = _train_cfg(tmp_path, steps_per_epoch=4)
+        res = trainer_mod.train_language_model(cfg)
+        assert len(res.history) == 1
+        assert math.isfinite(res.final_loss)
+        # the ONE honest fence per epoch — heartbeat + health monitor
+        # added none (the sync-discipline acceptance bar)
+        assert calls["n"] == cfg.train.epochs
+        recs = [json.loads(line) for line in
+                (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+        assert not [r for r in recs if r.get("name") == "health"]
+        hb = read_heartbeat(tmp_path / "heartbeat.json")
+        assert hb["phase"] == "done" and hb["run"] == res.run_id
+        assert hb["beats"] >= 2  # at least first step + terminal pulse
+        from hyperion_tpu.obs.doctor import diagnose
+
+        assert diagnose(tmp_path)["verdict"] == "healthy"
+
+    def test_no_telemetry_means_no_heartbeat_file(self, tmp_path, mesh_dp):
+        from hyperion_tpu.train.trainer import train_language_model
+
+        cfg = _train_cfg(tmp_path, steps_per_epoch=2, telemetry=False)
+        train_language_model(cfg)
+        assert not (tmp_path / "heartbeat.json").exists()
+        assert not (tmp_path / "telemetry.jsonl").exists()

@@ -1,0 +1,387 @@
+"""obs doctor / obs diff + the telemetry record contract.
+
+The golden fixture streams under tests/data/telemetry/ (regenerable via
+gen_fixtures.py there) are the compatibility anchor: the schema test
+pins every span/event/snapshot/heartbeat field that `doctor`, `diff`,
+and `summarize` read, so a producer-side refactor that would silently
+break offline tooling fails HERE, in tier-1, not in a post-mortem.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from hyperion_tpu.obs import diff as obs_diff
+from hyperion_tpu.obs import doctor, report
+from hyperion_tpu.obs.heartbeat import read_heartbeat
+from hyperion_tpu.obs.registry import MetricsRegistry
+from hyperion_tpu.obs.trace import Tracer
+
+FIXTURES = Path(__file__).parent / "data" / "telemetry"
+REPO = Path(__file__).resolve().parents[1]
+
+ALL_FIXTURES = ("healthy", "nan", "stalled", "hung", "crashed")
+
+
+class FakeClock:
+    def __init__(self, t: float):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def write_run(path, run: str, step_ms: float, *, steps: int = 8,
+              tokens_per_s: float = 4096.0, wall0: float = 1_000.0,
+              terminal: bool = True):
+    """One synthetic healthy-shaped run appended to `path`."""
+    clk, wall = FakeClock(100.0), FakeClock(wall0)
+    t = Tracer(path, run=run, proc=0, clock=clk, wall=wall)
+    t.event("train_start", job="language_ddp")
+    with t.span("epoch", step=0) as ep:
+        for i in range(steps):
+            with t.span("train_step", step=i):
+                clk.advance(step_ms / 1e3)
+                wall.advance(step_ms / 1e3)
+        ep.set(epoch=1, steps=steps)
+    reg = MetricsRegistry()
+    reg.gauge("tokens_per_s").set(tokens_per_s)
+    reg.gauge("mfu").set(0.3)
+    reg.gauge("hbm_peak_mb").set(512.0)
+    t.snapshot(reg, step=steps)
+    if terminal:
+        t.event("train_end", preempted=False)
+    t.close()
+
+
+# --------------------------------------------------------------- doctor
+
+
+class TestDoctorFixtures:
+    """The tier-1 smoke required by the issue: `hyperion_tpu obs doctor`
+    over every committed fixture stream, through the real CLI."""
+
+    @pytest.mark.parametrize("name,verdict,rc", [
+        ("healthy", "healthy", 0),
+        ("nan", "diverged", 1),
+        ("stalled", "stalled", 1),
+        ("hung", "hung", 1),
+        ("crashed", "crashed", 1),
+    ])
+    def test_cli_classifies_fixture(self, name, verdict, rc, capsys):
+        from hyperion_tpu.cli.main import main as cli_main
+
+        args = ["obs", "doctor", str(FIXTURES / name)]
+        if name == "stalled":
+            # "stalled" means alive-and-degrading: judge it from a
+            # vantage point where the committed heartbeat is fresh
+            # (staleness outranks the stall pattern — see the hung
+            # cross-check below)
+            hb = read_heartbeat(FIXTURES / name / "heartbeat.json")
+            args += ["--now", str(hb["t_wall"] + 30)]
+        code = cli_main(args)
+        out = capsys.readouterr().out
+        assert f"verdict: {verdict}" in out, out
+        assert code == rc
+
+    def test_stalled_then_dead_is_hung(self):
+        # the SAME degraded stream, judged long after the last beat:
+        # the process is gone, so staleness wins — with the stall
+        # history kept as evidence in the reason
+        d = doctor.diagnose(FIXTURES / "stalled")  # real now: very stale
+        assert d["verdict"] == "hung"
+        assert "degraded" in d["reason"]
+        assert d["stall"] is not None
+
+    def test_nan_fixture_evidence(self):
+        d = doctor.diagnose(FIXTURES / "nan")
+        assert d["verdict"] == "diverged"
+        assert any(h["anomaly"] == "nonfinite_loss"
+                   for h in d["health_events"])
+        assert d["heartbeat"]["phase"] == "aborted"
+
+    def test_stalled_fixture_evidence(self):
+        hb = read_heartbeat(FIXTURES / "stalled" / "heartbeat.json")
+        d = doctor.diagnose(FIXTURES / "stalled", now=hb["t_wall"] + 30)
+        assert d["verdict"] == "stalled"
+        assert d["stall"]["ratio"] >= doctor.STALL_RATIO
+        assert d["heartbeat"]["phase"] == "train"
+
+    def test_crashed_fixture_evidence(self):
+        d = doctor.diagnose(FIXTURES / "crashed")
+        assert d["verdict"] == "crashed"
+        assert d["truncated_tail"] is True and d["bad_lines"] == 1
+
+    def test_hung_fixture_goes_running_when_fresh(self):
+        # the SAME stream classifies as running when "now" is close to
+        # its timestamps — hung is purely a staleness verdict
+        hb = read_heartbeat(FIXTURES / "hung" / "heartbeat.json")
+        d = doctor.diagnose(FIXTURES / "hung", now=hb["t_wall"] + 10)
+        assert d["verdict"] == "running"
+        d = doctor.diagnose(FIXTURES / "hung", now=hb["t_wall"] + 10_000)
+        assert d["verdict"] == "hung"
+
+    def test_healthy_fixture_summary_fields(self):
+        d = doctor.diagnose(FIXTURES / "healthy")
+        assert d["verdict"] == "healthy"
+        assert d["steps"] == 8 and d["hbm_peak_mb"] == 900.0
+        assert d["heartbeat"]["phase"] == "done"
+
+    def test_missing_target_exits_2(self, tmp_path, capsys):
+        assert doctor.main([str(tmp_path / "nope")]) == 2
+        assert "no telemetry stream" in capsys.readouterr().err
+
+    def test_empty_stream_is_empty_verdict(self, tmp_path, capsys):
+        (tmp_path / "telemetry.jsonl").write_text("")
+        assert doctor.main([str(tmp_path)]) == 2
+        assert "empty" in capsys.readouterr().out
+
+    def test_json_output(self, capsys):
+        assert doctor.main([str(FIXTURES / "healthy"), "--json"]) == 0
+        assert json.loads(capsys.readouterr().out)["verdict"] == "healthy"
+
+    def test_report_entry_point_dispatches_doctor(self, monkeypatch,
+                                                  capsys):
+        # `python -m hyperion_tpu.obs.report doctor <dir>` — main(None)
+        # must resolve sys.argv BEFORE the doctor/diff dispatch
+        import sys as _sys
+
+        monkeypatch.setattr(_sys, "argv",
+                            ["report", "doctor", str(FIXTURES / "healthy")])
+        assert report.main() == 0
+        assert "verdict: healthy" in capsys.readouterr().out
+
+    def test_failed_publish_is_not_healthy(self, tmp_path):
+        # bench.py's dead-tunnel run completes its lifecycle but
+        # publishes value 0.0 with failed=true — the motivating silent
+        # failure must not classify healthy
+        t = Tracer(tmp_path / "telemetry.jsonl", run="bench_x", proc=0)
+        t.event("bench_start", metric="matmul")
+        t.event("publish", value=0.0, failed=True, error="tunnel dead")
+        t.close()
+        d = doctor.diagnose(tmp_path)
+        assert d["verdict"] == "failed"
+        assert "tunnel dead" in d["reason"]
+        assert doctor.EXIT_BY_VERDICT["failed"] == 1
+
+    def test_successful_publish_stays_healthy(self, tmp_path):
+        t = Tracer(tmp_path / "telemetry.jsonl", run="bench_y", proc=0)
+        t.event("bench_start", metric="matmul")
+        t.event("publish", value=175.75, plausible=True, vs_baseline=1.45)
+        t.close()
+        assert doctor.diagnose(tmp_path)["verdict"] == "healthy"
+
+    def test_foreign_heartbeat_is_ignored(self, tmp_path):
+        # heartbeat from a DIFFERENT run id must not vouch for this one
+        write_run(tmp_path / "telemetry.jsonl", "r_old", 10.0,
+                  terminal=False)
+        (tmp_path / "heartbeat.json").write_text(json.dumps(
+            {"v": 1, "run": "r_new", "t_wall": 2_000.0, "phase": "train"}
+        ))
+        d = doctor.diagnose(tmp_path, run="r_old", now=5_000.0)
+        assert d["heartbeat"] is None
+        assert d["verdict"] == "hung"  # stream stale, no heartbeat for it
+
+
+# -------------------------------------------------- telemetry contract
+
+
+class TestRecordContract:
+    """Pin the wire fields the offline tools rely on. A change that
+    breaks these breaks `obs doctor`/`diff`/`summarize` on every stream
+    already on disk — bump trace.SCHEMA_VERSION and migrate instead."""
+
+    RESERVED = ("v", "kind", "name", "run", "proc", "step", "t_wall",
+                "t_mono")
+
+    def records(self, name):
+        out = []
+        for line in (FIXTURES / name / "telemetry.jsonl").read_text() \
+                .splitlines():
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # the crashed fixture's torn tail, by design
+        assert out, f"fixture {name} unreadable"
+        return out
+
+    @pytest.mark.parametrize("name", ALL_FIXTURES)
+    def test_every_record_carries_envelope(self, name):
+        for r in self.records(name):
+            assert r["v"] == 1
+            assert r["kind"] in ("span", "event", "snapshot")
+            assert isinstance(r["name"], str)
+            assert isinstance(r["run"], str)
+            assert isinstance(r["proc"], int)
+            assert isinstance(r["t_wall"], (int, float))
+            assert isinstance(r["t_mono"], (int, float))
+            assert r["step"] is None or isinstance(r["step"], int)
+
+    @pytest.mark.parametrize("name", ALL_FIXTURES)
+    def test_span_records(self, name):
+        spans = [r for r in self.records(name) if r["kind"] == "span"]
+        assert spans
+        for s in spans:
+            assert isinstance(s["dur_ms"], (int, float))
+            assert isinstance(s["path"], str) and s["path"].endswith(s["name"])
+
+    def test_snapshot_record_shape(self):
+        (snap,) = [r for r in self.records("healthy")
+                   if r["kind"] == "snapshot"]
+        m = snap["metrics"]
+        assert set(m) == {"counters", "gauges", "histograms", "labels"}
+        # the gauges summarize/doctor/diff read
+        for g in ("tokens_per_s", "mfu", "hbm_peak_mb"):
+            assert g in m["gauges"]
+        assert "step_time_ms" in m["histograms"]
+
+    def test_health_event_shape(self):
+        (ev,) = [r for r in self.records("nan") if r["name"] == "health"]
+        assert ev["kind"] == "event"
+        assert ev["anomaly"] in ("nonfinite_loss", "nonfinite_grad",
+                                 "loss_spike", "grad_explosion",
+                                 "step_stall")
+        assert ev["fatal"] is True
+        assert ev["action"] in ("warn", "checkpoint", "abort")
+
+    @pytest.mark.parametrize("name", ALL_FIXTURES)
+    def test_heartbeat_contract(self, name):
+        hb = read_heartbeat(FIXTURES / name / "heartbeat.json")
+        assert hb is not None
+        for field, typ in (("v", int), ("run", str), ("pid", int),
+                           ("proc", int), ("step", int), ("phase", str),
+                           ("t_wall", (int, float)),
+                           ("t_mono", (int, float)), ("beats", int)):
+            assert isinstance(hb[field], typ), (name, field)
+
+    @pytest.mark.parametrize("name", ALL_FIXTURES)
+    def test_summarize_reads_every_fixture(self, name):
+        s = report.summarize(FIXTURES / name / "telemetry.jsonl")
+        assert not s.get("error")
+        assert s["steps"] >= 5
+
+
+# ----------------------------------------------------------------- diff
+
+
+class TestDiff:
+    def test_injected_step_time_regression_flagged(self, tmp_path, capsys):
+        """The acceptance bar: a >=10%% injected step-time regression
+        between two synthetic runs flips the exit code."""
+        from hyperion_tpu.cli.main import main as cli_main
+
+        a, b = tmp_path / "a", tmp_path / "b"
+        a.mkdir(), b.mkdir()
+        write_run(a / "telemetry.jsonl", "run_a", 10.0)
+        write_run(b / "telemetry.jsonl", "run_b", 12.0)  # +20% step time
+        rc = cli_main(["obs", "diff", str(a), str(b)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "REGRESSED" in out and "step_time_p50_ms" in out
+
+    def test_within_threshold_passes(self, tmp_path, capsys):
+        a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        write_run(a, "run_a", 10.0)
+        write_run(b, "run_b", 10.5)  # +5% < default 10%
+        assert obs_diff.main([str(a), str(b)]) == 0
+        assert "no regressions" in capsys.readouterr().out
+
+    def test_throughput_direction_is_inverted(self, tmp_path):
+        a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        write_run(a, "run_a", 10.0, tokens_per_s=4000.0)
+        write_run(b, "run_b", 10.0, tokens_per_s=3000.0)  # -25% tok/s
+        d = obs_diff.diff(obs_diff.load_summary(a),
+                          obs_diff.load_summary(b))
+        assert "tokens_per_s" in d["regressions"]
+        # and an IMPROVEMENT the other way is not a regression
+        d = obs_diff.diff(obs_diff.load_summary(b),
+                          obs_diff.load_summary(a))
+        assert "tokens_per_s" not in d["regressions"]
+
+    def test_threshold_is_configurable(self, tmp_path):
+        a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        write_run(a, "run_a", 10.0)
+        write_run(b, "run_b", 10.5)
+        assert obs_diff.main([str(a), str(b), "--threshold", "0.01"]) == 1
+
+    def test_normalize_bench_line(self):
+        m = obs_diff.normalize({
+            "metric": "matmul_bf16_8192_tflops", "value": 175.75,
+            "vs_baseline": 1.452,
+            "extra": {"lm_step_ms": 61.9, "lm_tokens_per_s": 66150.0},
+        })
+        assert m["headline_tflops"] == 175.75
+        assert m["vs_baseline"] == 1.452
+        assert m["lm_step_ms"] == 61.9
+
+    def test_normalize_round_wrapper_and_trainer_summary(self):
+        m = obs_diff.normalize({"rc": 0, "parsed": {
+            "metric": "x", "value": 120.0, "vs_baseline": 1.0}})
+        assert m["headline_tflops"] == 120.0
+        m = obs_diff.normalize({"step_ms": 42.0, "tokens_per_s": 1000.0,
+                                "peak_hbm_mb": 13580.0})
+        assert m["step_time_mean_ms"] == 42.0
+        assert m["hbm_peak_mb"] == 13580.0
+
+    def test_normalize_drops_nonfinite_and_unknown(self):
+        assert obs_diff.normalize({"tokens_per_s": float("nan"),
+                                   "unknown_key": 3}) == {}
+
+    def test_history_over_committed_bench_records(self, capsys):
+        rc = obs_diff.main(["--history", str(REPO / "BENCH_r0*.json")])
+        out = capsys.readouterr().out
+        assert rc == 0
+        for n in range(1, 6):
+            assert f"BENCH_r0{n}.json" in out
+        assert "headline_tflops" in out
+
+    def test_history_no_match_exits_2(self, tmp_path, capsys):
+        assert obs_diff.main(["--history",
+                              str(tmp_path / "none_*.json")]) == 2
+        assert "matched no files" in capsys.readouterr().err
+
+    def test_unreadable_input_exits_2(self, tmp_path, capsys):
+        good = tmp_path / "a.jsonl"
+        write_run(good, "r", 10.0)
+        assert obs_diff.main([str(good),
+                              str(tmp_path / "missing.json")]) == 2
+
+
+# ------------------------------------------- summarize failure satellite
+
+
+class TestSummarizeEmptyStreams:
+    def test_empty_file_one_line_nonzero(self, tmp_path, capsys):
+        p = tmp_path / "telemetry.jsonl"
+        p.write_text("")
+        assert report.main(["summarize", str(p)]) == 1
+        cap = capsys.readouterr()
+        assert cap.out == ""
+        assert len(cap.err.strip().splitlines()) == 1
+        assert "no parseable records" in cap.err
+
+    def test_garbage_only_file_nonzero(self, tmp_path, capsys):
+        p = tmp_path / "telemetry.jsonl"
+        p.write_text("not json\n{{{\n")
+        assert report.main(["summarize", str(p)]) == 1
+        assert "no parseable records" in capsys.readouterr().err
+
+    def test_filtered_to_empty_run_nonzero(self, tmp_path, capsys):
+        p = tmp_path / "telemetry.jsonl"
+        write_run(p, "real_run", 10.0)
+        assert report.main(["summarize", str(p), "--run", "ghost"]) == 1
+        cap = capsys.readouterr()
+        assert cap.out == ""  # never an all-zero report
+        assert "ghost" in cap.err and "--list-runs" in cap.err
+
+    def test_json_mode_also_errors_cleanly(self, tmp_path, capsys):
+        p = tmp_path / "telemetry.jsonl"
+        p.write_text("")
+        assert report.main(["summarize", str(p), "--json"]) == 1
+        assert capsys.readouterr().out == ""
