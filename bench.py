@@ -42,16 +42,22 @@ import os
 import subprocess
 import sys
 
-# Persistent XLA compilation cache: over the axon tunnel a cold GPT-2
-# train-step compile alone can exceed the child timeout (420s observed),
-# so repeat runs (watcher retries, the round-end driver bench) must not
-# re-pay it. Set before any jax import; harmless if the backend declines
-# to serialize. Benchmarked quantities are run times, never compile wall
-# time, so a warm cache changes setup cost only.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-)
+# Persistent XLA compilation cache FOR BENCH CHILDREN ONLY: over the
+# axon tunnel a cold GPT-2 train-step compile alone can exceed the child
+# timeout (420s observed), so repeat runs (watcher retries, the
+# round-end driver bench) must not re-pay it. Benchmarked quantities are
+# run times, never compile wall time, so a warm cache changes setup cost
+# only. Injected into each child's env by `_run_child` — NEVER
+# `os.environ.setdefault` at import: that mutated the importing
+# process's env (the test suite imports this module), every later
+# subprocess of that session inherited a SHARED on-disk cache, and on
+# the CPU backend reloading a cached executable aborts the process
+# (glibc heap corruption) — which read as "chaos-test children crash
+# when the whole suite runs" until bisected to here.
+_JAX_CACHE_ENV = {
+    "JAX_COMPILATION_CACHE_DIR":
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+}
 
 BASELINE_TFLOPS_BF16_8192 = 121.07  # MI250X bf16 8192^2 (BASELINE.md)
 # Shared window-health thresholds vs the committed record (the axon tunnel
@@ -305,7 +311,7 @@ def _run_child(
             [sys.executable, os.path.abspath(__file__), mode],
             capture_output=True, text=True, timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)),
-            env={**os.environ, **env} if env else None,
+            env={**os.environ, **_JAX_CACHE_ENV, **(env or {})},
         )
     except subprocess.TimeoutExpired:
         return None, (

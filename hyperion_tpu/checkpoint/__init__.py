@@ -1,14 +1,26 @@
-"""Sharded checkpointing with resume (exceeds the reference's save-only)."""
+"""Sharded checkpointing with verified resume (exceeds the reference's
+save-only): every save commits a manifest, restore walks back to the
+newest verified step, corrupt dirs are quarantined — never trusted,
+never deleted.
 
-from hyperion_tpu.checkpoint.io import (
-    export_gathered,
-    latest_step,
-    load_gathered,
-    prune,
-    restore,
-    save,
-)
+Import discipline: `integrity` is jax-free and imported eagerly; the
+orbax-backed IO surface (`save`/`restore`/...) resolves lazily via PEP
+562 so that jax-free consumers — the restart supervisor must stay
+responsive while a child wedges the backend — can `import
+hyperion_tpu.checkpoint` without pulling in jax/orbax/flax.
+"""
 
-__all__ = [
-    "export_gathered", "latest_step", "load_gathered", "prune", "restore", "save",
-]
+from hyperion_tpu.checkpoint import integrity  # noqa: F401
+
+_IO_NAMES = ("export_gathered", "latest_step", "load_gathered", "prune",
+             "restore", "save")
+
+__all__ = ["integrity", *_IO_NAMES]
+
+
+def __getattr__(name):
+    if name in _IO_NAMES:
+        from hyperion_tpu.checkpoint import io
+
+        return getattr(io, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,186 @@
+"""Checkpoint integrity: manifests, verification, quarantine.
+
+The failure this kills: a crash *during* `ckpt.save` leaves a partial
+`step_*` dir, `latest_step` happily picks it, and every future resume
+bricks on the same unreadable checkpoint — the run can no longer heal
+itself. The fix is a commit marker with teeth:
+
+  * `write_manifest`  — after an orbax save commits, the primary
+    process writes `manifest.json` into the step dir: file list with
+    sizes + sha256 checksums, the step, the mesh shape the state was
+    saved under, and the Pallas `KERNEL_REV` — enough to verify the
+    dir AND to explain, months later, what produced it.
+  * `verify`          — a dir is *verified* iff its manifest parses and
+    every listed file exists with the recorded size (and, in `deep`
+    mode, the recorded checksum). No manifest = the save never
+    committed = not a checkpoint.
+  * `quarantine`      — rename a failed dir to `step_X.corrupt` (never
+    delete: the bytes are evidence) with a `QUARANTINE_REASON.txt` and
+    a trace event, so `restore`'s walk-back skips it forever and a
+    human can audit what happened.
+
+`checkpoint/io.py` composes these: save → manifest; restore → walk
+back from the newest step to the newest verified one, quarantining
+failures on the way; prune → never deletes the newest verified dir.
+
+Multi-host note: manifest writes and quarantine renames are primary-
+process-only (same rank-0 discipline as the CSV logger); verification
+is pure reads, safe everywhere.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from pathlib import Path
+
+MANIFEST_NAME = "manifest.json"
+REASON_NAME = "QUARANTINE_REASON.txt"
+SCHEMA_VERSION = 1
+CORRUPT_SUFFIX = ".corrupt"
+
+
+def _sha256(path: Path, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with path.open("rb") as f:
+        while block := f.read(chunk):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _mesh_shape_of(state) -> dict | None:
+    """Best-effort mesh shape from the state's own array shardings —
+    a checkpoint resharded onto a different mesh is legal (restore takes
+    the template's sharding), but the manifest should record where the
+    bytes came from."""
+    try:
+        import jax
+
+        for leaf in jax.tree.leaves(state):
+            sh = getattr(leaf, "sharding", None)
+            mesh = getattr(sh, "mesh", None)
+            if mesh is not None and getattr(mesh, "shape", None):
+                return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    except Exception:  # noqa: BLE001 — provenance is best-effort
+        pass
+    return None
+
+
+def _kernel_rev() -> int | None:
+    try:
+        from hyperion_tpu.ops.pallas.flash_attention import KERNEL_REV
+
+        return int(KERNEL_REV)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def write_manifest(step_dir: str | Path, step: int, state=None,
+                   extra: dict | None = None) -> Path:
+    """Write `manifest.json` for a COMMITTED step dir (call only after
+    the orbax save returned). Hashing reads back everything just
+    written — for a test-scale checkpoint that is noise; for a 7B tree
+    it is one extra sequential read per epoch save, the price of a
+    resume that can prove its inputs."""
+    step_dir = Path(step_dir)
+    files = []
+    for p in sorted(step_dir.rglob("*")):
+        if not p.is_file() or p.name == MANIFEST_NAME:
+            continue
+        files.append({
+            "path": p.relative_to(step_dir).as_posix(),
+            "bytes": p.stat().st_size,
+            "sha256": _sha256(p),
+        })
+    manifest = {
+        "v": SCHEMA_VERSION,
+        "step": int(step),
+        "files": files,
+        "mesh_shape": _mesh_shape_of(state) if state is not None else None,
+        "kernel_rev": _kernel_rev(),
+        "written_at": time.time(),
+        **(extra or {}),
+    }
+    # atomic: a reader (or a crash mid-write) must never see a torn
+    # manifest — a partial manifest would quarantine a good checkpoint
+    path = step_dir / MANIFEST_NAME
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps(manifest, indent=1))
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(step_dir: str | Path) -> dict | None:
+    try:
+        m = json.loads((Path(step_dir) / MANIFEST_NAME).read_text())
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+    return m if isinstance(m, dict) else None
+
+
+def verify(step_dir: str | Path, deep: bool = True) -> tuple[bool, str]:
+    """(verified, reason). `deep=True` checks sha256s (restore-time:
+    about to read the bytes anyway); `deep=False` checks existence +
+    sizes only (prune-time protection: O(stat), not O(bytes))."""
+    step_dir = Path(step_dir)
+    if not step_dir.is_dir():
+        return False, "not a directory"
+    m = read_manifest(step_dir)
+    if m is None:
+        if (Path(step_dir) / MANIFEST_NAME).exists():
+            return False, "unreadable manifest"
+        return False, "missing manifest (save never committed)"
+    files = m.get("files")
+    if not isinstance(files, list):
+        return False, "manifest has no file list"
+    for entry in files:
+        rel = entry.get("path", "")
+        p = step_dir / rel
+        if not p.is_file():
+            return False, f"missing file {rel!r}"
+        if p.stat().st_size != entry.get("bytes"):
+            return False, (f"size mismatch on {rel!r}: "
+                           f"{p.stat().st_size} != {entry.get('bytes')}")
+        if deep and entry.get("sha256") and _sha256(p) != entry["sha256"]:
+            return False, f"checksum mismatch on {rel!r}"
+    return True, "ok"
+
+
+def quarantine(step_dir: str | Path, reason: str, tracer=None,
+               primary: bool | None = None) -> Path | None:
+    """Rename a failed step dir to `step_X.corrupt` (suffixing `.N` on
+    collision), drop a reason file inside, emit a trace event. Returns
+    the quarantine path, or None when another process owns the rename
+    (non-primary) or the dir vanished under us.
+
+    `primary` short-circuits the rank check for callers that must stay
+    jax-free: the restart supervisor IS the only process alive when it
+    quarantines, and asking `dist` would import jax — whose backend
+    init can block forever exactly when the supervisor is cleaning up
+    after a wedged child. Default (None) consults `dist` as before."""
+    if primary is None:
+        from hyperion_tpu.runtime import dist
+
+        primary = dist.is_primary()
+    step_dir = Path(step_dir)
+    if not primary or not step_dir.exists():
+        return None
+    dest = step_dir.with_name(step_dir.name + CORRUPT_SUFFIX)
+    n = 0
+    while dest.exists():
+        n += 1
+        dest = step_dir.with_name(f"{step_dir.name}{CORRUPT_SUFFIX}.{n}")
+    os.replace(step_dir, dest)
+    try:
+        (dest / REASON_NAME).write_text(
+            f"quarantined at {time.strftime('%Y-%m-%dT%H:%M:%S%z')}\n"
+            f"reason: {reason}\n"
+        )
+    except OSError:
+        pass  # the rename already protects resume; the note is best-effort
+    if tracer is not None:
+        tracer.event("checkpoint_quarantined", path=str(dest), reason=reason)
+    print(f"[checkpoint] quarantined {step_dir.name} -> {dest.name}: {reason}")
+    return dest

@@ -1,4 +1,4 @@
-"""Checkpointing: sharded save/restore + gathered export + real resume.
+"""Checkpointing: sharded save/restore + gathered export + verified resume.
 
 Reference (SURVEY §5.4): save-only, end-of-run. DDP does a rank-0
 `torch.save(model.module.state_dict())` (`distributed_utils.py:195-199`);
@@ -12,14 +12,28 @@ TPU-native shape, exceeding that:
     thing that OOMs, as the reference's try/except tacitly admits).
     Restore takes a sharding tree, so a checkpoint written on one mesh
     reshards onto another.
+  * **verified resume**  — `save` commits a `manifest.json` (file list,
+    sizes, checksums, step, mesh shape, kernel rev —
+    `checkpoint/integrity.py`) after the orbax write returns; `restore`
+    walks back from the newest step to the newest *verified* one,
+    quarantining failures as `step_X.corrupt` instead of bricking every
+    future resume on one partial dir.
+  * **retry/backoff**    — checkpoint IO routes through
+    `utils.retry.retry_call`: transient storage faults (the only kind a
+    preemptible fleet sees at scale) back off and retry; permanent ones
+    surface to the walk-back.
   * `export_gathered`    — full params gathered to host and written as a
     single `.npz` (the FULL_STATE_DICT/rank0 analogue) for interchange.
-  * `latest_step` + step-numbered directories — actual resume.
+  * `latest_step` + step-numbered directories — actual resume. Health
+    evidence snapshots live under a `health/` subdir, which this
+    module's root-level scans never see — evidence can neither evict an
+    epoch checkpoint from `prune` nor masquerade as the resume point.
 """
 
 from __future__ import annotations
 
 import re
+import shutil
 from pathlib import Path
 from typing import Any
 
@@ -28,8 +42,10 @@ import numpy as np
 import orbax.checkpoint as ocp
 from flax import traverse_util
 
+from hyperion_tpu.checkpoint import integrity
 from hyperion_tpu.runtime import dist
 from hyperion_tpu.train.state import TrainState
+from hyperion_tpu.utils.retry import IO_RETRY, fault_point, retry_call
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 
@@ -38,63 +54,158 @@ def _step_path(root: str | Path, step: int) -> Path:
     return Path(root).absolute() / f"step_{step:08d}"
 
 
+def _step_dirs(root: Path) -> list[tuple[int, Path]]:
+    """(step, path) for every live step dir, ascending, as ABSOLUTE
+    paths (orbax rejects relative ones). `step_X.corrupt` quarantine
+    dirs and the `health/` evidence subdir don't match."""
+    root = Path(root).absolute()
+    if not root.is_dir():
+        return []
+    return sorted(
+        (int(m.group(1)), p)
+        for p in root.iterdir()
+        if (m := _STEP_DIR.match(p.name)) and p.is_dir()
+    )
+
+
 def save(root: str | Path, state: TrainState, force: bool = False) -> Path:
-    """Write a sharded checkpoint at the state's current step."""
+    """Write a sharded checkpoint at the state's current step, then
+    commit it with a manifest (primary process). A dir without a
+    manifest is, by definition, a save that never finished — restore's
+    walk-back will quarantine it."""
     step = int(state.step)
     path = _step_path(root, step)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, state, force=force)
+    attempt = {"n": 0}
+
+    def _write():
+        fault_point("ckpt_save")
+        # a retried attempt may land on the partial dir the failed one
+        # left behind — force the overwrite there even when the caller
+        # didn't ask for one
+        f = force or attempt["n"] > 0
+        attempt["n"] += 1
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, state, force=f)
+
+    retry_call(_write, policy=IO_RETRY,
+               on_retry=lambda a, e, d: print(
+                   f"[checkpoint] save attempt {a + 1} failed ({e}); "
+                   f"retrying in {d:.2f}s"))
+    if dist.is_primary():
+        integrity.write_manifest(path, step=step, state=state)
     return path
 
 
 def prune(root: str | Path, keep: int = 2) -> None:
     """Delete all but the newest `keep` step directories — an epoch of a
     7B full fine-tune writes tens of GB of params + Adam state, and
-    restore only ever reads the latest step."""
+    restore only ever reads the newest verified step. Three hygiene
+    rules: quarantined `*.corrupt` dirs are never touched (they are
+    evidence, and already out of the step namespace); the `health/`
+    evidence subdir is invisible here; and the newest VERIFIED dir
+    survives even when `keep` would doom it — pruning must never leave
+    the tree with only unverifiable checkpoints."""
     root = Path(root)
-    if not root.is_dir():
+    dirs = _step_dirs(root)
+    if not dirs:
         return
-    steps = sorted(
-        int(m.group(1))
-        for p in root.iterdir()
-        if (m := _STEP_DIR.match(p.name))
+    # shallow verification (manifest + sizes): O(stat) per dir per
+    # epoch, not O(checkpoint bytes) — deep hashing belongs to restore
+    newest_verified = next(
+        (step for step, p in reversed(dirs) if integrity.verify(p, deep=False)[0]),
+        None,
     )
-    for step in steps[:-keep] if keep else steps:
-        import shutil
-
-        shutil.rmtree(_step_path(root, step), ignore_errors=True)
+    doomed = dirs[:-keep] if keep else dirs
+    for step, p in doomed:
+        if step == newest_verified:
+            continue
+        shutil.rmtree(p, ignore_errors=True)
 
 
 def latest_step(root: str | Path) -> int | None:
-    root = Path(root)
-    if not root.is_dir():
-        return None
-    steps = [
-        int(m.group(1))
-        for p in root.iterdir()
-        if (m := _STEP_DIR.match(p.name)) and not p.name.endswith(".tmp")
-    ]
+    steps = [step for step, _ in _step_dirs(Path(root))]
     return max(steps, default=None)
 
 
-def restore(
-    root: str | Path, template: TrainState, step: int | None = None
-) -> TrainState | None:
-    """Restore the latest (or given) step directly into the template's
-    sharding — each device reads only the shards it owns, so restore
-    scales like sharded save did. `template` is a freshly-initialized
-    state (the trainer builds one anyway); a checkpoint written on a
-    different mesh reshards onto the template's. Returns None when there
-    is nothing to restore (fresh run)."""
-    step = step if step is not None else latest_step(root)
-    if step is None:
-        return None
+def _restore_step(path: Path, template: TrainState) -> TrainState:
     target = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
         template,
     )
-    with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(_step_path(root, step), target)
+
+    def _read():
+        fault_point("ckpt_restore")
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(path, target)
+
+    return retry_call(_read, policy=IO_RETRY,
+                      on_retry=lambda a, e, d: print(
+                          f"[checkpoint] restore attempt {a + 1} failed "
+                          f"({e}); retrying in {d:.2f}s"))
+
+
+def restore(
+    root: str | Path, template: TrainState, step: int | None = None,
+    tracer=None,
+) -> TrainState | None:
+    """Restore the newest VERIFIED step directly into the template's
+    sharding — each device reads only the shards it owns, so restore
+    scales like sharded save did. `template` is a freshly-initialized
+    state (the trainer builds one anyway); a checkpoint written on a
+    different mesh reshards onto the template's.
+
+    Walk-back: steps are tried newest-first; a dir that fails
+    verification (partial save, bit rot, chaos) or errors mid-restore
+    is quarantined as `step_X.corrupt` with a reason file and a
+    `checkpoint_quarantined` trace event, and the walk continues to the
+    prior step. Returns None when nothing restorable remains (fresh
+    run). An explicit `step` is verified and restored with no fallback
+    — the caller asked for those exact bytes, so failure raises."""
+    root = Path(root)
+    if step is not None:
+        path = _step_path(root, step)
+        ok, reason = integrity.verify(path)
+        if not ok:
+            # same legacy allowance as the walk-back below: a committed
+            # pre-manifest checkpoint restores; anything else raises
+            if not (reason.startswith("missing manifest")
+                    and (path / "_CHECKPOINT_METADATA").exists()):
+                raise ValueError(
+                    f"checkpoint step {step} at {path} failed "
+                    f"verification: {reason}")
+        return _restore_step(path, template)
+    for step, path in reversed(_step_dirs(root)):
+        ok, reason = integrity.verify(path)
+        # "missing manifest" covers two populations: a partial dir from
+        # a crashed save, and every checkpoint written BEFORE manifests
+        # existed. Quarantining the latter would silently discard all
+        # pre-upgrade progress, so orbax's own commit marker arbitrates:
+        # a finalized save has `_CHECKPOINT_METADATA` (written last) —
+        # with it, the dir is a committed legacy checkpoint and is
+        # adopted (manifest backfilled on successful restore); without
+        # it, the save provably never finished. (orbax restore alone
+        # cannot arbitrate: it reads damaged dirs without complaint,
+        # which is why the manifest layer exists at all.)
+        legacy = (reason.startswith("missing manifest")
+                  and (path / "_CHECKPOINT_METADATA").exists())
+        if ok or legacy:
+            try:
+                restored = _restore_step(path, template)
+            except Exception as e:  # noqa: BLE001 — quarantine + walk on
+                reason = (f"{reason}; restore failed: {e!r}" if not ok
+                          else f"verified but restore failed: {e!r}")
+            else:
+                if not ok and dist.is_primary():
+                    print(f"[checkpoint] adopted legacy checkpoint "
+                          f"{path.name} (no manifest, orbax commit "
+                          "marker present); backfilling a manifest")
+                    integrity.write_manifest(path, step=step,
+                                             state=restored)
+                return restored
+        elif reason.startswith("missing manifest"):
+            reason += " and no orbax commit marker — partial save"
+        integrity.quarantine(path, reason, tracer=tracer)
+    return None
 
 
 def export_gathered(path: str | Path, params: Any) -> Path | None:

@@ -52,6 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--llama_size", choices=["tiny", "7b", "70b"], default="7b")
     p.add_argument("--steps-per-epoch", type=int, default=0,
                    help="cap steps per epoch (0 = full pass)")
+    p.add_argument("--seq_len", type=int, default=0,
+                   help="token window for LM jobs (0 = the reference's "
+                        "128); smoke/chaos runs shrink it")
     p.add_argument("--precision", choices=["fp32", "bf16", "bf16_full"],
                    default="bf16")
     p.add_argument("--mesh", default=None,
@@ -108,6 +111,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default="",
                    help="capture a jax.profiler trace of the first epoch "
                         "into this directory (TensorBoard/XProf format)")
+    p.add_argument("--chaos", default="",
+                   help="deterministic fault plan (testing/chaos.py): "
+                        "comma-separated kill@step=N, sigterm@step=N, "
+                        "nan_loss@step=N, stall@step=N:SECS, "
+                        "corrupt_ckpt@latest, io_fail@p=X; step faults "
+                        "fire once per run lineage")
+    p.add_argument("--supervise", action="store_true",
+                   help="run the trainer as a supervised subprocess: on "
+                        "nonzero exit consult `obs doctor` — crashed/"
+                        "hung/preempted restart with backoff (resuming "
+                        "from the newest verified checkpoint), diverged "
+                        "quarantines the newest checkpoint first "
+                        "(train/supervisor.py)")
+    p.add_argument("--max-restarts", type=int, default=2,
+                   help="--supervise: restarts before giving up with "
+                        "exit 3")
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--lr-schedule", default="constant",
                    choices=["constant", "cosine", "warmup_cosine"],
@@ -164,7 +183,10 @@ def make_config(args, job: str) -> Config:
     cfg.train.warmup_steps = args.warmup_steps
     cfg.train.weight_decay = d.get("weight_decay", 0.0)
     cfg.train.steps_per_epoch = args.steps_per_epoch
+    if args.seq_len:
+        cfg.train.seq_len = args.seq_len
     cfg.train.train_split = args.train_split
+    cfg.train.chaos = args.chaos
     cfg.train.validate = not args.no_validate
     cfg.train.telemetry = not args.no_telemetry
     cfg.train.heartbeat_every = args.heartbeat_every
@@ -219,8 +241,30 @@ def run_job(args, job: str):
     raise ValueError(job)
 
 
+def _strip_supervise_flags(argv: list[str]) -> list[str]:
+    """The child command = this command minus the supervision flags —
+    a supervised child must never recursively supervise."""
+    out, skip = [], False
+    for a in argv:
+        if skip:
+            skip = False
+        elif a == "--supervise":
+            pass
+        elif a == "--max-restarts":
+            skip = True
+        elif a.startswith("--max-restarts="):
+            pass
+        else:
+            out.append(a)
+    return out
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "train":
+        # `hyperion train --supervise ...` — explicit-subcommand alias
+        # for the default training surface (obs already dispatches so)
+        argv = argv[1:]
     if argv and argv[0] == "obs":
         # telemetry subcommands (`obs summarize <telemetry.jsonl>`,
         # `obs doctor <run dir>`, `obs diff <a> <b>`) — pure file
@@ -233,7 +277,17 @@ def main(argv=None) -> int:
     if args.dry_init and args.model == "scaling":
         p.error("--dry-init plans a single job's TrainState; it does not "
                 "apply to the scaling sweep (pick one of its jobs instead)")
+    if args.supervise:
+        # the supervisor stays jax-free and re-execs THIS command (minus
+        # the supervision flags) as the child it watches
+        from hyperion_tpu.train.supervisor import supervise
+
+        child = [sys.executable, "-m", "hyperion_tpu.cli.main",
+                 *_strip_supervise_flags(argv)]
+        return supervise(child, base_dir=args.base_dir,
+                         max_restarts=args.max_restarts)
     dist.setup()
+    rc = 0
 
     if args.model == "scaling":
         from hyperion_tpu.bench.scaling import SCALING_JOBS, run_scaling_experiment
@@ -249,18 +303,34 @@ def main(argv=None) -> int:
             validate=not args.no_validate,
         )
     else:
+        # lazy: `hyperion obs ...` must not pay the trainer import chain
+        from hyperion_tpu.train.supervisor import (
+            EXIT_HEALTH_ABORT,
+            EXIT_PREEMPTED,
+        )
+
         jobs = (
             ["language_ddp", "cifar", "language_fsdp", "llama"]
             if args.model == "all" else [args.model]
         )
         for job in jobs:  # reference 'all' runs the four jobs sequentially
-            run_job(args, job)
+            res = run_job(args, job)
+            # exit codes the supervisor (and any watcher) branches on:
+            # 4 = health policy aborted a diverged run (quarantine then
+            # restart from the prior verified step); 75 = clean
+            # preemption with a resumable checkpoint (EX_TEMPFAIL —
+            # restart when capacity returns). A diverged verdict
+            # outranks a preemption from an earlier job in --model all.
+            if res.preempted == "health_abort":
+                rc = EXIT_HEALTH_ABORT
+            elif res.preempted and rc == 0:
+                rc = EXIT_PREEMPTED
 
     # scaling already reported from inside run_scaling_experiment
     if args.model != "scaling" and dist.is_primary():
         create_scaling_report(f"{args.base_dir}/distributed")
     dist.cleanup()
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

@@ -111,6 +111,11 @@ class TrainConfig:
     # warn = print + trace event; checkpoint = also save a tagged
     # checkpoint; abort = stop the run (exports skipped, like preemption)
     health_policy: str = "warn"
+    # deterministic fault-injection plan (testing/chaos.py): e.g.
+    # "kill@step=6,corrupt_ckpt@latest". Empty = HYPERION_CHAOS env,
+    # else off. Step faults fire once per run lineage (fire record in
+    # <base_dir>/chaos_state.json survives supervisor restarts).
+    chaos: str = ""
     profile_dir: str = ""            # jax.profiler trace of epoch 1 (off when empty)
     seed: int = 0
     base_dir: str = "data"

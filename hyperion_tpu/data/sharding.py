@@ -86,10 +86,15 @@ class ShardedBatches:
         after a preemption: the SAME seeded permutation, minus the
         already-trained prefix — skipped batches are never materialized
         on device."""
+        from hyperion_tpu.utils.retry import fault_point
+
         order = np.arange(self.n)
         if self.shuffle:
             np.random.default_rng((self.seed, epoch)).shuffle(order)
         for s in range(start_step, self.steps_per_epoch):
+            # chaos seam (no-op unless a fault injector is registered):
+            # where a streaming loader's per-batch read fault would land
+            fault_point("data_iter")
             idx = order[s * self.global_batch : (s + 1) * self.global_batch]
             yield {
                 k: self._make_global(v, idx) for k, v in self.arrays.items()

@@ -173,6 +173,20 @@ def load_wikitext2(
     sizes (36718/3760/4358 — SURVEY C18), scaled down 8x so CPU test
     runs stay fast; pass `synthetic_sizes` to override.
     """
+    from hyperion_tpu.utils.retry import IO_RETRY, fault_point, retry_call
+
+    def _read(fn):
+        """Dataset reads ride the IO retry/backoff: a transient storage
+        fault (NFS failover, flaky tunnel — or a chaos `io_fail` plan)
+        backs off and retries instead of crashing the epoch; truly
+        corrupt bytes (ValueError from verify/parse) surface at once."""
+
+        def _go():
+            fault_point("data_read")
+            return fn()
+
+        return retry_call(_go, policy=IO_RETRY)
+
     base = Path(base_dir) / "wikitext2_tokenized"
     sizes = {"train": 4590, "validation": 470, "test": 545}
     if synthetic_sizes:
@@ -184,7 +198,7 @@ def load_wikitext2(
         s = None
         if (base / f"{split}.ids.rio").exists():
             try:  # half-written prepare output falls through, like every
-                s = load_recordio_split(base, split)  # other source
+                s = _read(lambda: load_recordio_split(base, split))  # other source
             except (OSError, ValueError, KeyError) as e:
                 # ValueError/KeyError: truncated or field-less JSON sidecar
                 print(f"[load_wikitext2] recordio {split} unreadable "
@@ -192,9 +206,9 @@ def load_wikitext2(
         if s is not None:
             pass
         elif arrow_dir.is_dir() and list(arrow_dir.glob("data-*.arrow")):
-            s = load_arrow_split(arrow_dir)
+            s = _read(lambda: load_arrow_split(arrow_dir))
         elif npz.exists():
-            s = load_token_file(npz)
+            s = _read(lambda: load_token_file(npz))
         else:
             s = synthetic_lm_split(sizes.get(split, 512), seq_len=seq_len, seed=seed + i)
         s.verify()

@@ -36,6 +36,13 @@ Consumer/health half (PR 2 — the stream diagnosing its own runs):
   * `diff`      — `obs diff <a> <b>`: percent-delta comparison of two
                   run summaries with a regression threshold, plus
                   `--history` trajectory tables over e.g. BENCH_r*.json.
+
+Reaction half (PR 3 — `train/supervisor.py` + `checkpoint/integrity.py`):
+the doctor's verdicts drive a restart supervisor (crashed/hung ->
+restart from the newest verified checkpoint; diverged -> quarantine
+first), each relaunch stamps `attempt` into heartbeat + `train_start`
+so `doctor` reports restart lineage, and `preempt_signal` events mark
+signal latches the instant they happen.
 """
 
 from hyperion_tpu.obs.health import (  # noqa: F401

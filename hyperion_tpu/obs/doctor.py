@@ -128,6 +128,24 @@ def diagnose(
     events = [r for r in recs if r.get("kind") == "event"]
     spans = [r for r in recs if r.get("kind") == "span"]
     snapshots = [r for r in recs if r.get("kind") == "snapshot"]
+    # restart lineage: the supervisor stamps HYPERION_ATTEMPT into each
+    # child's train_start event and heartbeat. Lineage spans RUNS (each
+    # attempt is its own run id), so it is collected stream-wide.
+    attempts = sorted({
+        int(r["attempt"]) for r in records
+        if r.get("kind") == "event" and r.get("name") == "train_start"
+        and isinstance(r.get("attempt"), (int, float))
+    })
+    attempt = next(
+        (int(e["attempt"]) for e in reversed(events)
+         if e.get("name") == "train_start"
+         and isinstance(e.get("attempt"), (int, float))),
+        None,
+    )
+    if attempt is None and hb is not None \
+            and isinstance(hb.get("attempt"), (int, float)):
+        attempt = int(hb["attempt"])
+    latched = [e for e in events if e.get("name") == "preempt_signal"]
     health = [e for e in events if e.get("name") == "health"]
     fatal = [e for e in health if e.get("anomaly") in _FATAL_KINDS
              or e.get("fatal")]
@@ -199,6 +217,13 @@ def diagnose(
             f"span {errored_spans[-1].get('name')!r} recorded "
             f"{errored_spans[-1].get('error')!r}"
         )
+        if latched:
+            # the guard latched a signal before death: this is a
+            # preemption whose grace window ran out mid-shutdown, not
+            # an unprovoked crash — a supervisor should just resume
+            reason += (f"; preemption signal had latched at step "
+                       f"{latched[-1].get('step')} — died during "
+                       "shutdown, not unprovoked")
     elif stale:
         # Staleness outranks the stall signal: "stalled" means the loop
         # is alive-and-degrading (watch it, don't kill it) — a process
@@ -215,6 +240,10 @@ def diagnose(
         if stall:
             reason += (f"; tail steps had degraded {stall['ratio']}x "
                        "before the loop stopped")
+        if latched:
+            reason += (f"; preemption signal had latched at step "
+                       f"{latched[-1].get('step')} — died during "
+                       "shutdown, not unprovoked")
     elif stall:
         verdict = "stalled"
         reason = (
@@ -241,6 +270,8 @@ def diagnose(
         "bad_lines": bad_lines,
         "truncated_tail": truncated_tail,
         "last_step": last_step,
+        "attempt": attempt,
+        "attempts": attempts,
         "steps": len(step_ms),
         "step_time_ms": {
             "p50": percentile(step_ms, 50),
@@ -311,6 +342,12 @@ def render_markdown(d: dict) -> str:
         f"| last step | {_fmt(d['last_step'])} |",
         f"| step spans | {d['steps']} |",
     ]
+    if d.get("attempts") and (len(d["attempts"]) > 1 or max(d["attempts"])):
+        lineage = "→".join(str(a) for a in d["attempts"])
+        lines.append(
+            f"| restart lineage | attempts {lineage} "
+            f"({len(d['attempts'])} launch(es); this run is attempt "
+            f"{_fmt(d.get('attempt'))}) |")
     st = d.get("step_time_ms")
     if st:
         lines.append(f"| step time p50 / p99 | {_fmt(st['p50'])} / "

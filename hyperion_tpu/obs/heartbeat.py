@@ -57,6 +57,7 @@ class Heartbeat:
         every: int = 25,
         interval_s: float = 15.0,
         enabled: bool = True,
+        static: dict | None = None,
         clock=time.monotonic,
         wall=time.time,
     ):
@@ -64,6 +65,9 @@ class Heartbeat:
         self.enabled = bool(enabled and self.path is not None)
         self.run = run or f"run_{int(wall())}"
         self.proc = proc
+        # fields stamped on EVERY beat (e.g. the supervisor restart
+        # attempt) — per-call extras override on collision
+        self.static = dict(static) if static else {}
         self.every = max(1, int(every))
         self.interval_s = interval_s
         self._clock = clock
@@ -127,6 +131,7 @@ class Heartbeat:
             "t_wall": self._wall(),
             "t_mono": self._last_t,
             "beats": self._beats,
+            **self.static,
             **extra,
         }
         try:

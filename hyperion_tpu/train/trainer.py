@@ -22,6 +22,7 @@ per step, which is *less* overhead than the reference paid.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Callable
 
@@ -65,6 +66,7 @@ from hyperion_tpu.obs.health import HealthConfig, HealthMonitor
 from hyperion_tpu.models.transformer_lm import TransformerLM, simple_lm_config
 from hyperion_tpu.parallel.partition import TRANSFORMER_TP_RULES
 from hyperion_tpu.precision.policy import get_policy
+from hyperion_tpu.testing import chaos as chaos_mod
 from hyperion_tpu.runtime import dist
 from hyperion_tpu.runtime.mesh import make_mesh
 from hyperion_tpu.train.losses import classification_loss, next_token_loss
@@ -94,6 +96,11 @@ class TrainResult:
     csv_path: str
     checkpoint_dir: str | None
     history: list[EpochRecord]
+    # how the epoch loop stopped: False = ran to completion, True = a
+    # preemption signal (resumable — the CLI exits 75 so a supervisor
+    # restarts), "health_abort" = the health policy stopped a diverged
+    # run (CLI exits 4 — the supervisor quarantines before restarting)
+    preempted: Any = False
 
     @property
     def final_loss(self) -> float:
@@ -178,11 +185,16 @@ def _health_react(
     `warn` prints (primary only — the event is already in the trace);
     `checkpoint` saves a step-tagged snapshot and continues — evidence
     preservation for statistical anomalies (spikes/explosions), where
-    the state is still finite. If ANY anomaly fired this step is fatal,
-    nothing saves: the optimizer already applied the non-finite update,
-    and a poisoned tree must not become the newest checkpoint `restore`
-    would pick — a fatal can co-fire with a non-fatal on one step, so
-    the whole fired batch is inspected, not just the last anomaly."""
+    the state is still finite. Evidence lands under a `health/` SUBDIR
+    of the checkpoint dir: a snapshot in the root step namespace would
+    both evict an epoch checkpoint from `prune(keep=2)` and be deleted
+    itself two epochs later — and `latest_step` must never pick an
+    anomaly snapshot as the resume point. If ANY anomaly fired this
+    step is fatal, nothing saves: the optimizer already applied the
+    non-finite update, and a poisoned tree must not become the newest
+    checkpoint `restore` would pick — a fatal can co-fire with a
+    non-fatal on one step, so the whole fired batch is inspected, not
+    just the last anomaly."""
     fired = monitor.last_escalated or monitor.anomalies[-1:]
     if dist.is_primary():
         for anom in fired:
@@ -193,7 +205,8 @@ def _health_react(
             and not any(a.fatal for a in fired):
         anom = fired[-1]
         with tracer.span("checkpoint", reason=f"health_{anom.kind}"):
-            _save_checkpoint(ckpt_dir, state, f"health_{anom.step}")
+            _save_checkpoint(f"{ckpt_dir}/health", state,
+                             f"health_{anom.step}")
     return action == "abort"
 
 
@@ -233,8 +246,17 @@ def _epoch_loop(
     # file IO riding the tracer's enablement (rank-0 only, like the
     # CSV); the monitor consumes python floats only — neither can add a
     # device sync to the step loop (obs/health.py's sync discipline).
+    # restart lineage: the supervisor stamps HYPERION_ATTEMPT on each
+    # child it launches; every heartbeat carries it so `obs doctor` can
+    # report which launch of the lineage a dead run was
+    attempt = int(os.environ.get("HYPERION_ATTEMPT", "0") or 0)
     hb = obs_heartbeat.Heartbeat.for_tracer(
-        tracer, every=cfg.train.heartbeat_every or 25)
+        tracer, every=cfg.train.heartbeat_every or 25,
+        static={"attempt": attempt})
+    # deterministic fault injection (testing/chaos.py): activated by
+    # _prepare_run when a plan is configured, None otherwise — the hooks
+    # below are single attribute checks when chaos is off
+    plan = chaos_mod.current()
     monitor = (
         HealthMonitor(HealthConfig(policy=cfg.train.health_policy),
                       tracer=tracer)
@@ -261,6 +283,16 @@ def _epoch_loop(
     fence_every_step = jax.default_backend() == "cpu"
     max_steps = cfg.train.steps_per_epoch or None
     guard = guard if guard is not None else PreemptionGuard()
+    # a latched signal must hit the flight recorder the MOMENT it lands,
+    # not after the checkpoint IO that follows — if the grace window
+    # expires mid-save, the trace still shows "preempted cleanly, died
+    # during shutdown" instead of an unprovoked crash (obs doctor reads
+    # the preempt_signal event). Events flush eagerly; both writes are
+    # tiny host file IO, safe inside a signal handler.
+    guard.on_latch = lambda signum: (
+        tracer.event("preempt_signal", signal=int(signum), attempt=attempt),
+        hb.pulse(phase="preempt_latched"),
+    )
     n_proc = dist.process_count()
 
     def abort_exit(epoch: int, n_steps: int):
@@ -318,6 +350,13 @@ def _epoch_loop(
                 for i, batch in enumerate(batches.epoch(epoch, start), start):
                     if max_steps and i >= max_steps:
                         break
+                    gstep = epoch * steps_per_epoch + i
+                    if plan is not None:
+                        # chaos hook: kill/sigterm/stall fire BEFORE the
+                        # step trains, so "kill@step=N" means steps
+                        # 0..N-1 completed — the resume-equality tests
+                        # depend on that boundary being exact
+                        plan.on_step(gstep)
                     # stop check BEFORE the step: a signal that lands
                     # during validation/checkpoint IO must not burn one
                     # more training step on the way out
@@ -341,7 +380,6 @@ def _epoch_loop(
                     # sp.dur_s is dispatch time; the throughput GAUGES
                     # are set from the fenced epoch duration below
                     observe_step(reg, sp.dur_s, **thru_kw)
-                    gstep = epoch * steps_per_epoch + i
                     if cfg.train.heartbeat_every:
                         hb.beat(step=gstep, phase="train", epoch=epoch + 1)
                     if monitor is not None:
@@ -352,10 +390,16 @@ def _epoch_loop(
                         # — the epoch-end check below covers non-finite
                         # divergence from the already-fetched mean.
                         # Step time is host-side either way.
+                        loss_val = (float(metrics["loss"])
+                                    if fence_every_step else None)
+                        if plan is not None and loss_val is not None:
+                            # chaos nan_loss@step=N: the monitor sees a
+                            # NaN — divergence on demand, exercising the
+                            # health->abort->supervisor-quarantine path
+                            loss_val = plan.poison_loss(gstep, loss_val)
                         action = monitor.observe_step(
                             gstep,
-                            loss=(float(metrics["loss"])
-                                  if fence_every_step else None),
+                            loss=loss_val,
                             grad_norm=(float(metrics["grad_norm"])
                                        if fence_every_step
                                        and "grad_norm" in metrics else None),
@@ -429,10 +473,18 @@ def _epoch_loop(
                 # row, so this adds zero fetches. A NaN anywhere in the
                 # epoch poisons the mean; divergence is caught one
                 # epoch late at worst.
+                end_gstep = (epoch * steps_per_epoch + start
+                             + len(device_metrics))
+                monitor_loss = loss
+                if plan is not None:
+                    # chaos nan_loss on lazy backends: poison the value
+                    # the monitor judges (not the CSV row) when this
+                    # epoch covered the target step — same granularity
+                    # the monitor itself has here
+                    monitor_loss = plan.poison_epoch(
+                        epoch * steps_per_epoch + start, end_gstep, loss)
                 action = monitor.observe_epoch(
-                    epoch + 1,
-                    epoch * steps_per_epoch + start + len(device_metrics),
-                    loss)
+                    epoch + 1, end_gstep, monitor_loss)
                 if action != "none" and _health_react(
                     job, action, monitor, state, ckpt_dir, tracer
                 ):
@@ -616,6 +668,18 @@ def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int,
         "train_start", job=job, n_devices=n_devices,
         batch_size=cfg.train.batch_size, seq_len=cfg.train.seq_len,
         epochs=cfg.train.epochs, backend=jax.default_backend(),
+        attempt=int(os.environ.get("HYPERION_ATTEMPT", "0") or 0),
+    )
+    # deterministic fault injection: activate the plan (or clear a
+    # previous run's) BEFORE restore — corrupt_ckpt@latest corrupts at
+    # activation, and the walk-back below must be what discovers it.
+    # The fire record persists under base_dir so supervisor-restarted
+    # children never re-fire an already-executed fault.
+    chaos_mod.activate(
+        cfg.train.chaos,
+        state_path=f"{cfg.train.base_dir}/chaos_state.json",
+        seed=cfg.train.seed,
+        checkpoint_root=f"{cfg.train.base_dir}/checkpoints",
     )
     # world-size-specific, like the reference's run ids: a 2-device run
     # must not resume a 1-device run's checkpoint (their shardings and
@@ -629,7 +693,7 @@ def _prepare_run(job: str, cfg: Config, state, batches, n_devices: int,
             f"zero steps per epoch: batch_size {cfg.train.batch_size} vs "
             f"dataset of {batches.n} examples (drop_last semantics)"
         )
-    restored = ckpt.restore(ckpt_dir, state)
+    restored = ckpt.restore(ckpt_dir, state, tracer=tracer)
     resume_epoch, resume_step = 0, 0
     if restored is not None:
         state = restored
@@ -896,7 +960,8 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
             f"{cfg.train.base_dir}/checkpoints/{job}{tree_tag}_final.npz",
             state.params,
         )
-    return TrainResult(job, logger.run, str(logger.path), ckpt_dir, history)
+    return TrainResult(job, logger.run, str(logger.path), ckpt_dir, history,
+                       preempted=preempted)
 
 
 def train_cifar_model(cfg: Config, job: str = "cifar_ddp") -> TrainResult:
@@ -996,7 +1061,8 @@ def train_cifar_model(cfg: Config, job: str = "cifar_ddp") -> TrainResult:
         ckpt.export_gathered(
             f"{cfg.train.base_dir}/checkpoints/{job}_final.npz", state.params
         )
-    return TrainResult(job, logger.run, str(logger.path), ckpt_dir, history)
+    return TrainResult(job, logger.run, str(logger.path), ckpt_dir, history,
+                       preempted=preempted)
 
 
 def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
@@ -1259,4 +1325,5 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
             f"{cfg.train.base_dir}/checkpoints/{job}_{mode}_merged.npz",
             merge_lora(state.params["base"], state.params["lora"], lora_cfg),
         )
-    return TrainResult(job, logger.run, str(logger.path), ckpt_dir, history)
+    return TrainResult(job, logger.run, str(logger.path), ckpt_dir, history,
+                       preempted=preempted)

@@ -36,9 +36,17 @@ class PreemptionGuard:
 
     SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
-    def __init__(self) -> None:
+    def __init__(self, on_latch=None) -> None:
         self._event = threading.Event()
         self._prev: dict[int, object] = {}
+        # observer called ONCE, from the handler, when the first signal
+        # latches: the epoch loop points it at the trace/heartbeat so a
+        # preemption is on disk the moment it lands — if the grace
+        # window expires during the checkpoint save that follows, the
+        # post-mortem still shows "signal latched at step N", not an
+        # unprovoked crash. Exceptions are swallowed: observability must
+        # never break the graceful-exit path it observes.
+        self.on_latch = on_latch
 
     @property
     def triggered(self) -> bool:
@@ -56,6 +64,11 @@ class PreemptionGuard:
             signal.signal(signum, prev)
             raise KeyboardInterrupt(f"second signal {signum} during shutdown")
         self._event.set()
+        if self.on_latch is not None:
+            try:
+                self.on_latch(signum)
+            except Exception:  # noqa: BLE001 — see __init__
+                pass
         print(f"[preemption] caught signal {signum}; finishing current step, "
               "then checkpointing and exiting (send again to kill now)")
 

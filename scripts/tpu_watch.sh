@@ -86,9 +86,21 @@ print('tpu up:', getattr(d, 'device_kind', '?'))
       echo "[watch] capture complete"
       exit 0
     fi
-    # a flapping tunnel can kill the capture seconds after a good probe;
-    # each stage commits incrementally, so retrying on the next probe is
-    # safe and preserves the rest of the watch window
+    # Supervisor exit contract (hyperion_tpu/train/supervisor.py):
+    # training stages run under `--supervise`, which already retried
+    # crashed/hung/diverged children with doctor-guided recovery. rc 3
+    # means that restart budget is EXHAUSTED — re-firing the capture
+    # from out here is the old double-retry failure mode (it burns the
+    # watch window re-dying the same death); stop and leave the
+    # telemetry for a human + `obs doctor`.
+    if [ "$rc" -eq 3 ]; then
+      echo "[watch] supervised stage gave up after exhausting restarts" \
+           "(rc=3); NOT re-firing — triage with 'hyperion obs doctor'"
+      exit 3
+    fi
+    # any other rc: a flapping tunnel can kill the capture seconds after
+    # a good probe; each stage commits incrementally, so retrying on the
+    # next probe is safe and preserves the rest of the watch window
     echo "[watch] capture rc=$rc (tunnel flapped?); continuing to watch"
   fi
   echo "[watch] tunnel down at $(date -u +%FT%TZ); retrying in ${PROBE_SLEEP}s"

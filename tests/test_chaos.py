@@ -1,0 +1,246 @@
+"""testing/chaos.py + utils/retry.py — the fault-injection harness and
+the backoff layer it exists to exercise."""
+
+import json
+import signal
+
+import pytest
+
+from hyperion_tpu.testing import chaos
+from hyperion_tpu.utils import retry
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_plan():
+    """Chaos is process-ambient; never leak a plan (or its io_fail
+    injector) into other tests."""
+    yield
+    chaos.activate("")
+
+
+# ----------------------------------------------------------- retry unit
+
+class TestRetry:
+    def test_retries_transient_then_succeeds(self):
+        calls, delays = {"n": 0}, []
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("blip")
+            return "ok"
+
+        out = retry.retry_call(
+            flaky, policy=retry.RetryPolicy(tries=3, base_delay_s=0.1,
+                                            jitter=0.0),
+            sleep=delays.append,
+        )
+        assert out == "ok" and calls["n"] == 3
+        assert delays == [0.1, 0.2]  # exponential, jitter off
+
+    def test_permanent_errors_never_retry(self):
+        calls = {"n": 0}
+
+        def broken():
+            calls["n"] += 1
+            raise ValueError("the bytes are wrong, not late")
+
+        with pytest.raises(ValueError):
+            retry.retry_call(broken, sleep=lambda s: None)
+        assert calls["n"] == 1
+
+    def test_exhausted_tries_raise_last(self):
+        with pytest.raises(OSError, match="always"):
+            retry.retry_call(
+                lambda: (_ for _ in ()).throw(OSError("always")),
+                policy=retry.RetryPolicy(tries=2, base_delay_s=0.0),
+                sleep=lambda s: None,
+            )
+
+    def test_deadline_stops_before_tries(self):
+        calls = {"n": 0}
+        now = {"t": 0.0}
+
+        def flaky():
+            calls["n"] += 1
+            raise OSError("blip")
+
+        with pytest.raises(OSError):
+            retry.retry_call(
+                flaky,
+                policy=retry.RetryPolicy(tries=50, base_delay_s=10.0,
+                                         max_delay_s=10.0, deadline_s=15.0,
+                                         jitter=0.0),
+                sleep=lambda s: now.__setitem__("t", now["t"] + s),
+                clock=lambda: now["t"],
+            )
+        assert calls["n"] == 2  # 10s + next 10s sleep would cross 15s
+
+    def test_delay_capped_and_jittered_deterministically(self):
+        import random
+
+        pol = retry.RetryPolicy(base_delay_s=1.0, max_delay_s=4.0, jitter=0.25)
+        assert pol.delay(10, random.Random(0)) <= 4.0 * 1.25
+        assert pol.delay(0, random.Random(7)) == pol.delay(0, random.Random(7))
+
+    def test_fault_point_noop_without_injector(self):
+        retry.set_fault_injector(None)
+        retry.fault_point("anything")  # must not raise
+
+
+# ----------------------------------------------------------- plan parse
+
+class TestParse:
+    def test_full_grammar(self):
+        plan = chaos.parse_plan(
+            "kill@step=3, sigterm@step=5; nan_loss@step=2,"
+            "stall@step=4:1.5,corrupt_ckpt@latest,io_fail@p=0.25"
+        )
+        kinds = [f.kind for f in plan]
+        assert kinds == ["kill", "sigterm", "nan_loss", "stall",
+                         "corrupt_ckpt", "io_fail"]
+        assert plan[3].secs == 1.5 and plan[5].p == 0.25
+        assert plan[0].key == "kill@step=3"
+
+    @pytest.mark.parametrize("bad", [
+        "explode@step=3", "kill@step=x", "io_fail@p=1.5", "stall@step=4",
+    ])
+    def test_bad_clauses_raise(self, bad):
+        with pytest.raises(ValueError):
+            chaos.parse_plan(bad)
+
+
+# ------------------------------------------------------------ execution
+
+class TestFiring:
+    def test_step_faults_fire_once_per_lineage(self, tmp_path, monkeypatch):
+        sent = []
+        monkeypatch.setattr(chaos.os, "kill", lambda pid, sig: sent.append(sig))
+        state = tmp_path / "chaos_state.json"
+        plan = chaos.ChaosPlan(chaos.parse_plan("sigterm@step=2"),
+                               state_path=state)
+        plan.on_step(1)
+        assert sent == []
+        plan.on_step(2)
+        assert sent == [signal.SIGTERM]
+        plan.on_step(2)  # same process: fire record holds
+        assert sent == [signal.SIGTERM]
+        # a restarted process (new plan, same state file) must not
+        # re-die at the same step — the fire record persisted
+        assert "sigterm@step=2" in json.loads(state.read_text())["fired"]
+        plan2 = chaos.ChaosPlan(chaos.parse_plan("sigterm@step=2"),
+                                state_path=state)
+        plan2.on_step(2)
+        assert sent == [signal.SIGTERM]
+
+    def test_mark_precedes_execution(self, tmp_path, monkeypatch):
+        """SIGKILL never returns: the fire record must be on disk BEFORE
+        the fault executes."""
+        state = tmp_path / "chaos_state.json"
+        plan = chaos.ChaosPlan(chaos.parse_plan("kill@step=0"),
+                               state_path=state)
+
+        def boom(pid, sig):
+            assert "kill@step=0" in json.loads(state.read_text())["fired"]
+            raise SystemExit(137)  # stand-in for the real SIGKILL
+
+        monkeypatch.setattr(chaos.os, "kill", boom)
+        with pytest.raises(SystemExit):
+            plan.on_step(0)
+
+    def test_poison_loss(self):
+        plan = chaos.ChaosPlan(chaos.parse_plan("nan_loss@step=7"))
+        assert plan.poison_loss(6, 1.25) == 1.25
+        assert plan.poison_loss(7, 1.25) != plan.poison_loss(7, 1.25) or \
+            plan.poison_loss(7, 1.25) == 1.25  # NaN != NaN, then pass-through
+        import math
+
+        fresh = chaos.ChaosPlan(chaos.parse_plan("nan_loss@step=7"))
+        assert math.isnan(fresh.poison_loss(7, 1.25))
+        assert fresh.poison_loss(7, 1.25) == 1.25  # one-shot
+
+    def test_stall_sleeps(self, monkeypatch):
+        slept = []
+        monkeypatch.setattr(chaos.time, "sleep", slept.append)
+        plan = chaos.ChaosPlan(chaos.parse_plan("stall@step=3:0.5"))
+        plan.on_step(3)
+        assert slept == [0.5]
+
+    def test_io_fail_deterministic_and_retriable(self):
+        plan_a = chaos.ChaosPlan(chaos.parse_plan("io_fail@p=0.5"), seed=3)
+        plan_b = chaos.ChaosPlan(chaos.parse_plan("io_fail@p=0.5"), seed=3)
+
+        def outcomes(plan, n=32):
+            out = []
+            for _ in range(n):
+                try:
+                    plan.io_fail("t")
+                    out.append(False)
+                except OSError:
+                    out.append(True)
+            return out
+
+        a = outcomes(plan_a)
+        assert a == outcomes(plan_b) and True in a and False in a
+        # p=1 always raises; the retry layer surfaces it after backoff
+        always = chaos.ChaosPlan(chaos.parse_plan("io_fail@p=1"))
+        retry.set_fault_injector(always.io_fail)
+        try:
+            with pytest.raises(OSError, match="injected io_fail"):
+                retry.retry_call(
+                    lambda: retry.fault_point("ckpt_save"),
+                    policy=retry.RetryPolicy(tries=3, base_delay_s=0.0),
+                    sleep=lambda s: None,
+                )
+        finally:
+            retry.set_fault_injector(None)
+
+    def test_corrupt_latest_checkpoint(self, tmp_path):
+        root = tmp_path / "checkpoints"
+        old = root / "job_8dev" / "step_00000004"
+        new = root / "job_8dev" / "step_00000008"
+        for d in (old, new):
+            d.mkdir(parents=True)
+            (d / "payload.bin").write_bytes(b"x" * 1000)
+        plan = chaos.ChaosPlan(chaos.parse_plan("corrupt_ckpt@latest"))
+        target = plan.corrupt_latest_checkpoint(root)
+        assert target == new
+        assert (new / "payload.bin").stat().st_size == 500
+        assert (old / "payload.bin").stat().st_size == 1000
+        # one-shot: a second activation leaves the tree alone
+        assert plan.corrupt_latest_checkpoint(root) is None
+
+
+class TestActivation:
+    def test_activate_installs_plan_and_injector(self, tmp_path):
+        plan = chaos.activate("io_fail@p=1",
+                              state_path=tmp_path / "state.json")
+        assert chaos.current() is plan
+        with pytest.raises(OSError):
+            retry.fault_point("anywhere")
+        chaos.activate("")  # clears plan AND injector
+        assert chaos.current() is None
+        retry.fault_point("anywhere")
+
+    def test_lineage_resets_once_per_process(self, tmp_path, monkeypatch):
+        """A fresh attempt-0 process starts a new lineage (stale fire
+        records cleared), but LATER activations in the same process —
+        `--model all` activates once per job — stay in the lineage and
+        must not re-arm already-fired faults."""
+        monkeypatch.delenv("HYPERION_ATTEMPT", raising=False)
+        state = tmp_path / "chaos_state.json"
+        state.write_text(json.dumps({"fired": ["nan_loss@step=1"]}))
+        p1 = chaos.activate("nan_loss@step=1", state_path=state)
+        assert p1._fired == set()  # stale record from a prior lineage
+        import math
+
+        assert math.isnan(p1.poison_loss(1, 0.5))  # fires + persists
+        p2 = chaos.activate("nan_loss@step=1", state_path=state)  # job 2
+        assert p2.poison_loss(1, 0.5) == 0.5  # NOT re-armed mid-lineage
+
+    def test_empty_spec_reads_env(self, monkeypatch):
+        monkeypatch.setenv(chaos.ENV_VAR, "nan_loss@step=1")
+        plan = chaos.activate(None)
+        assert plan is not None and plan.faults[0].kind == "nan_loss"
+        monkeypatch.delenv(chaos.ENV_VAR)
+        assert chaos.activate(None) is None

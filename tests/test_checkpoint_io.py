@@ -1,0 +1,231 @@
+"""checkpoint/ — dedicated coverage for io.py + integrity.py.
+
+The module had no test file of its own (round-trip coverage lived in
+test_train.py); this one pins the verified-resume contract: every save
+commits a manifest, restore walks back to the newest verified step
+quarantining failures, prune never deletes the newest verified dir,
+and the `health/` evidence subdir is invisible to root-level scans.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from hyperion_tpu import checkpoint as ckpt
+from hyperion_tpu.checkpoint import integrity
+from hyperion_tpu.checkpoint.integrity import MANIFEST_NAME, REASON_NAME
+from hyperion_tpu.models.transformer_lm import TransformerLM, simple_lm_config
+from hyperion_tpu.train.state import create_train_state, make_optimizer
+from hyperion_tpu.utils import retry as retry_mod
+
+
+@pytest.fixture(scope="module")
+def state(mesh8):
+    cfg = simple_lm_config(vocab_size=64, d_model=16, n_heads=2, n_layers=1,
+                           ff_dim=32, max_len=8, dropout=0.0)
+    model = TransformerLM(cfg)
+    st, _ = create_train_state(
+        lambda r: {"params": model.init_params(r)}, make_optimizer(1e-2),
+        mesh8, jax.random.key(0), policy="fp32",
+    )
+    return st
+
+
+def corrupt_payload(step_dir):
+    """Truncate the largest non-manifest file — the partial-write shape
+    a mid-save crash leaves."""
+    payload = max(
+        (p for p in step_dir.rglob("*")
+         if p.is_file() and p.name != MANIFEST_NAME),
+        key=lambda p: p.stat().st_size,
+    )
+    size = payload.stat().st_size
+    with payload.open("r+b") as f:
+        f.truncate(size // 2)
+    return payload
+
+
+class TestSaveRestore:
+    def test_roundtrip(self, state, tmp_path):
+        path = ckpt.save(tmp_path / "ck", state)
+        assert path.exists()
+        restored = ckpt.restore(tmp_path / "ck", state)
+        assert int(restored.step) == int(state.step)
+        for a, b in zip(jax.tree.leaves(restored.params),
+                        jax.tree.leaves(state.params)):
+            np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                          np.asarray(b, np.float32))
+        # sharding preserved: restore targets the template's layout
+        assert restored.params["tok_emb"]["embedding"].sharding.spec == \
+            state.params["tok_emb"]["embedding"].sharding.spec
+
+    def test_restore_empty_dir_is_fresh_run(self, state, tmp_path):
+        assert ckpt.restore(tmp_path / "nothing", state) is None
+
+    def test_save_writes_committing_manifest(self, state, tmp_path):
+        path = ckpt.save(tmp_path / "ck", state)
+        m = json.loads((path / MANIFEST_NAME).read_text())
+        assert m["step"] == int(state.step)
+        assert m["kernel_rev"] is not None
+        assert m["mesh_shape"]["data"] == 2 and m["mesh_shape"]["fsdp"] == 4
+        listed = {f["path"] for f in m["files"]}
+        on_disk = {p.relative_to(path).as_posix() for p in path.rglob("*")
+                   if p.is_file() and p.name != MANIFEST_NAME}
+        assert listed == on_disk and listed
+        assert all(f["sha256"] and f["bytes"] >= 0 for f in m["files"])
+        assert integrity.verify(path) == (True, "ok")
+
+    def test_save_retries_transient_io(self, state, tmp_path):
+        calls = {"n": 0}
+
+        def flaky(tag):
+            if tag == "ckpt_save":
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise OSError("first attempt eats a storage blip")
+
+        retry_mod.set_fault_injector(flaky)
+        try:
+            path = ckpt.save(tmp_path / "ck", state)
+        finally:
+            retry_mod.set_fault_injector(None)
+        assert calls["n"] == 2  # failed once, retried, committed
+        assert integrity.verify(path)[0]
+
+
+class TestVerification:
+    def test_missing_manifest_means_uncommitted(self, state, tmp_path):
+        path = ckpt.save(tmp_path / "ck", state)
+        (path / MANIFEST_NAME).unlink()
+        ok, reason = integrity.verify(path)
+        assert not ok and "missing manifest" in reason
+
+    def test_size_and_hash_mismatches(self, state, tmp_path):
+        path = ckpt.save(tmp_path / "ck", state)
+        payload = corrupt_payload(path)
+        ok, reason = integrity.verify(path, deep=False)
+        assert not ok and "size mismatch" in reason
+        # same size, different bytes: only the deep (hash) check sees it
+        path2 = ckpt.save(tmp_path / "ck2", state)
+        m = json.loads((path2 / MANIFEST_NAME).read_text())
+        target = max(m["files"], key=lambda f: f["bytes"])
+        p = path2 / target["path"]
+        raw = bytearray(p.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        p.write_bytes(bytes(raw))
+        assert integrity.verify(path2, deep=False)[0]
+        ok, reason = integrity.verify(path2, deep=True)
+        assert not ok and "checksum mismatch" in reason
+        del payload
+
+
+class TestWalkBack:
+    def _save_at(self, root, state, step):
+        return ckpt.save(root, state.replace(step=state.step + step))
+
+    def test_corrupt_latest_falls_back_and_quarantines(self, state, tmp_path):
+        root = tmp_path / "ck"
+        self._save_at(root, state, 0)
+        newest = self._save_at(root, state, 5)
+        corrupt_payload(newest)
+        restored = ckpt.restore(root, state)
+        assert int(restored.step) == int(state.step)  # fell back to step 0
+        corrupt = root / "step_00000005.corrupt"
+        assert corrupt.is_dir() and not newest.exists()
+        reason = (corrupt / REASON_NAME).read_text()
+        assert "size mismatch" in reason
+
+    def test_all_corrupt_returns_none(self, state, tmp_path):
+        root = tmp_path / "ck"
+        p = self._save_at(root, state, 0)
+        # a true partial dir: neither our manifest nor orbax's own
+        # commit marker — the save provably never finished, so the
+        # legacy-adoption path must not even attempt a restore
+        (p / MANIFEST_NAME).unlink()
+        (p / "_CHECKPOINT_METADATA").unlink()
+        assert ckpt.restore(root, state) is None
+        corrupt = root / "step_00000000.corrupt"
+        assert corrupt.is_dir()
+        assert "partial save" in (corrupt / REASON_NAME).read_text()
+
+    def test_legacy_checkpoint_without_manifest_is_adopted(
+        self, state, tmp_path
+    ):
+        """Checkpoints written before manifests existed must survive the
+        upgrade: a manifest-less dir that orbax restores cleanly is
+        adopted (manifest backfilled), not quarantined."""
+        root = tmp_path / "ck"
+        p = self._save_at(root, state, 0)
+        (p / MANIFEST_NAME).unlink()  # simulate a pre-manifest save
+        restored = ckpt.restore(root, state)
+        assert restored is not None and int(restored.step) == int(state.step)
+        assert p.is_dir() and not (root / "step_00000000.corrupt").exists()
+        assert integrity.verify(p)[0]  # backfilled manifest verifies
+
+    def test_explicit_corrupt_step_raises(self, state, tmp_path):
+        root = tmp_path / "ck"
+        p = self._save_at(root, state, 3)
+        corrupt_payload(p)
+        with pytest.raises(ValueError, match="failed verification"):
+            ckpt.restore(root, state, step=3)
+        assert p.exists()  # explicit requests never quarantine
+
+
+class TestLatestStepAndPrune:
+    def _save_at(self, root, state, step):
+        return ckpt.save(root, state.replace(step=state.step + step))
+
+    def test_latest_step_ignores_corrupt_and_health(self, state, tmp_path):
+        root = tmp_path / "ck"
+        self._save_at(root, state, 2)
+        newest = self._save_at(root, state, 7)
+        corrupt_payload(newest)
+        integrity.quarantine(newest, "test")
+        # health evidence snapshots live in a subdir: never a resume point
+        self._save_at(root / "health", state, 9)
+        assert ckpt.latest_step(root) == 2
+        assert ckpt.latest_step(root / "health") == 9
+
+    def test_prune_skips_corrupt_and_protects_newest_verified(
+        self, state, tmp_path
+    ):
+        root = tmp_path / "ck"
+        self._save_at(root, state, 0)
+        self._save_at(root, state, 5)
+        newest = self._save_at(root, state, 9)
+        (newest / MANIFEST_NAME).unlink()  # newest never committed
+        quarantined = self._save_at(root, state, 7)
+        corrupt_payload(quarantined)
+        integrity.quarantine(quarantined, "test")
+        ckpt.prune(root, keep=1)
+        names = sorted(p.name for p in root.iterdir())
+        # keep=1 keeps step_9 (newest); step_5 survives as the newest
+        # VERIFIED dir; step_0 is deleted; the quarantine is untouched
+        assert names == ["step_00000005", "step_00000007.corrupt",
+                         "step_00000009"]
+        # even keep=0 must not delete the last verified checkpoint
+        ckpt.prune(root, keep=0)
+        assert sorted(p.name for p in root.iterdir()) == [
+            "step_00000005", "step_00000007.corrupt"]
+
+    def test_prune_never_touches_health_subdir(self, state, tmp_path):
+        root = tmp_path / "ck"
+        self._save_at(root, state, 0)
+        self._save_at(root, state, 4)
+        self._save_at(root / "health", state, 2)
+        ckpt.prune(root, keep=1)
+        assert ckpt.latest_step(root / "health") == 2
+        assert ckpt.latest_step(root) == 4
+
+
+class TestGatheredExport:
+    def test_roundtrip(self, state, tmp_path):
+        p = ckpt.export_gathered(tmp_path / "full.npz", state.params)
+        loaded = ckpt.load_gathered(p)
+        np.testing.assert_array_equal(
+            loaded["tok_emb"]["embedding"],
+            np.asarray(state.params["tok_emb"]["embedding"]),
+        )
+        assert set(loaded) == set(state.params)

