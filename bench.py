@@ -25,6 +25,11 @@ fence reports dispatch time and once "measured" 41,999 TFLOPS on a
 Robustness: measurements run in bounded subprocesses so a hung backend
 cannot hang the driver; failures still print ONE parseable JSON line.
 
+Secondary rows riding the same line: `extra` (GPT-2 LM train-step
+throughput) and `input_pipeline` (host batch-assembly rate, sync vs
+background-prefetched — chip-free, so it is attached to failure lines
+too and `obs diff --history` tracks it across BENCH_r*.json).
+
 Telemetry: the probe/retry/deadline lifecycle additionally streams as
 `obs` events (probe_attempt, probe_result, measure_attempt,
 measure_result, deadline, cpu_sanity, publish) — opt-in via
@@ -224,6 +229,57 @@ def _child_probe() -> None:
     }))
 
 
+def _child_input_pipeline() -> None:
+    """Host input-pipeline probe: batches/sec of `ShardedBatches` epoch
+    assembly, sync vs background-prefetched (data/prefetch.py), under a
+    small fixed simulated per-batch step so the prefetch thread has
+    compute to hide behind — the ratio is the fraction of host assembly
+    the overlap actually removed from the critical path. Runs on the
+    host backend (the parent forces JAX_PLATFORMS=cpu): the measured
+    quantity is host assembly + dispatch rate; no chip involved, so
+    this row survives dead-tunnel rounds and `obs diff --history` can
+    track it across BENCH_r*.json regardless."""
+    import time
+
+    import jax
+
+    from hyperion_tpu.data.prefetch import Prefetcher
+    from hyperion_tpu.data.sharding import ShardedBatches
+    from hyperion_tpu.data.text import synthetic_lm_split
+    from hyperion_tpu.runtime.mesh import MeshSpec, make_mesh
+
+    # sized so assembly is a visible fraction of the simulated step —
+    # a probe whose assembly rounds to zero can't show overlap moving
+    global_batch, depth, step_s = 256, 2, 0.002
+    split = synthetic_lm_split(2048, seq_len=512, seed=0)
+    batches = ShardedBatches(split.arrays(), global_batch,
+                             make_mesh(MeshSpec(data=-1)), seed=0)
+
+    def rate(d: int, epochs: int = 3) -> float:
+        n = 0
+        t0 = time.perf_counter()
+        for ep in range(epochs):
+            with Prefetcher(batches.epoch(ep), depth=d) as feed:
+                for b in feed:
+                    jax.block_until_ready(b["input_ids"])
+                    time.sleep(step_s)  # the stand-in device step
+                    n += 1
+        return n / (time.perf_counter() - t0)
+
+    rate(0, epochs=1)  # warmup: first-touch allocations, thread pools
+    sync = rate(0)
+    prefetched = rate(depth)
+    print(json.dumps({
+        "sync_batches_per_s": round(sync, 2),
+        "prefetch_batches_per_s": round(prefetched, 2),
+        "speedup": round(prefetched / sync, 3) if sync else None,
+        "global_batch": global_batch,
+        "prefetch_depth": depth,
+        "simulated_step_ms": step_s * 1e3,
+        "seq_len": 512,
+    }))
+
+
 def _child_cpu_sanity() -> None:
     """The SAME measurement harness on the host CPU backend at small N.
     When the live value is 0.0 this row proves the harness itself works
@@ -327,6 +383,26 @@ def _run_child(
         except json.JSONDecodeError:
             continue
     return None, f"{mode} produced no JSON output"
+
+
+def _add_input_pipeline(out: dict, hb, tracer, remaining) -> None:
+    """Attach the host-backend input-pipeline probe row (sync vs
+    prefetched batch assembly, `--child-input-pipeline`). Chip-free, so
+    it rides BOTH the success and the dead-tunnel failure line — `obs
+    diff --history` keeps a continuous trajectory for it either way."""
+    if remaining() < 60:
+        out["input_pipeline"] = {"error": "deadline reached; skipped"}
+        tracer.event("deadline", where="input_pipeline",
+                     remaining_s=round(remaining(), 1))
+        return
+    hb.pulse(phase="input_pipeline")
+    pipe, perr = _run_child(
+        "--child-input-pipeline", int(min(180, remaining() - 30)),
+        env={"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+    )
+    out["input_pipeline"] = pipe if pipe is not None else {"error": perr}
+    tracer.event("input_pipeline", ok=pipe is not None, error=perr or None,
+                 speedup=(pipe or {}).get("speedup"))
 
 
 def main() -> None:
@@ -501,6 +577,7 @@ def main() -> None:
                 "last_committed is the most recent git-committed real-chip "
                 "capture, NOT a live number"
             )
+        _add_input_pipeline(out, hb, tracer, remaining)
         tracer.event("publish", value=0.0, failed=True, error=err)
         hb.close(phase="done", value=0.0)
         tracer.close()
@@ -554,6 +631,7 @@ def main() -> None:
             out["extra"] = {"error": extra_err}
     else:
         out["extra"] = {"error": "deadline reached; skipped"}
+    _add_input_pipeline(out, hb, tracer, remaining)
     tracer.event("publish", value=out["value"], plausible=plausible,
                  vs_baseline=out["vs_baseline"])
     hb.close(phase="done", value=out["value"])
@@ -568,6 +646,8 @@ if __name__ == "__main__":
         _child_lm_step()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child-probe":
         _child_probe()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--child-input-pipeline":
+        _child_input_pipeline()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child-cpu-sanity":
         _child_cpu_sanity()
     else:

@@ -13,7 +13,7 @@ hyperion_tpu.checkpoint` without pulling in jax/orbax/flax.
 from hyperion_tpu.checkpoint import integrity  # noqa: F401
 
 _IO_NAMES = ("export_gathered", "latest_step", "load_gathered", "prune",
-             "restore", "save")
+             "restore", "save", "wait_pending")
 
 __all__ = ["integrity", *_IO_NAMES]
 

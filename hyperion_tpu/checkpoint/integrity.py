@@ -50,7 +50,7 @@ def _sha256(path: Path, chunk: int = 1 << 20) -> str:
     return h.hexdigest()
 
 
-def _mesh_shape_of(state) -> dict | None:
+def mesh_shape_of(state) -> dict | None:
     """Best-effort mesh shape from the state's own array shardings —
     a checkpoint resharded onto a different mesh is legal (restore takes
     the template's sharding), but the manifest should record where the
@@ -98,7 +98,7 @@ def write_manifest(step_dir: str | Path, step: int, state=None,
         "v": SCHEMA_VERSION,
         "step": int(step),
         "files": files,
-        "mesh_shape": _mesh_shape_of(state) if state is not None else None,
+        "mesh_shape": mesh_shape_of(state) if state is not None else None,
         "kernel_rev": _kernel_rev(),
         "written_at": time.time(),
         **(extra or {}),

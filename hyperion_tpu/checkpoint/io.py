@@ -12,6 +12,20 @@ TPU-native shape, exceeding that:
     thing that OOMs, as the reference's try/except tacitly admits).
     Restore takes a sharding tree, so a checkpoint written on one mesh
     reshards onto another.
+  * **async saves**      — `save(..., wait=False)` returns as soon as
+    the device arrays are snapshotted to host (orbax's async dispatch);
+    the disk write streams out on a background thread while training
+    continues. `wait_pending()` is the commit point: it blocks on
+    `wait_until_finished()` and only THEN writes the integrity
+    manifest, so an interrupted async save is indistinguishable from
+    any other uncommitted dir (orbax stages into a
+    `*.orbax-checkpoint-tmp-*` dir that the `step_*` regex never
+    matches; a kill mid-write leaves no resume candidate at all, and a
+    kill after orbax's rename but before the manifest leaves an
+    unverified dir the walk-back arbitrates via orbax's own commit
+    marker). At most ONE save is in flight: a new `save` (and
+    `restore`) finalizes the previous one first, and every trainer
+    exit path drains via `wait_pending` before exporting.
   * **verified resume**  — `save` commits a `manifest.json` (file list,
     sizes, checksums, step, mesh shape, kernel rev —
     `checkpoint/integrity.py`) after the orbax write returns; `restore`
@@ -34,6 +48,7 @@ from __future__ import annotations
 
 import re
 import shutil
+import time
 from pathlib import Path
 from typing import Any
 
@@ -43,11 +58,64 @@ import orbax.checkpoint as ocp
 from flax import traverse_util
 
 from hyperion_tpu.checkpoint import integrity
+from hyperion_tpu.obs import trace as obs_trace
 from hyperion_tpu.runtime import dist
 from hyperion_tpu.train.state import TrainState
 from hyperion_tpu.utils.retry import IO_RETRY, fault_point, retry_call
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
+
+# The one in-flight async save (ocp.StandardCheckpointer IS an
+# AsyncCheckpointer — the old code's `with` block just closed, and
+# thereby fenced, it immediately). Holding the state tree until commit
+# would pin buffers the train step wants to donate, so the record keeps
+# only what the manifest needs: path, step, and the mesh provenance
+# captured eagerly at dispatch.
+_PENDING: dict | None = None
+
+
+def wait_pending(tracer=None) -> Path | None:
+    """Block until the in-flight async save (if any) commits, then
+    write its manifest — the ONLY place a manifest follows an async
+    dispatch, which is what makes "manifest present" mean "the bytes
+    all landed". Returns the committed path, or None when nothing was
+    pending or the commit failed (the dir is left unverified for the
+    restore walk-back to arbitrate — exactly like a crash would).
+
+    Emits the `ckpt_commit` half of the async-save span pair;
+    `overlap_s` on it is the wall time training ran while the write
+    streamed (dispatch return -> commit wait start)."""
+    global _PENDING
+    if _PENDING is None:
+        return None
+    pend, _PENDING = _PENDING, None
+    tr = tracer or obs_trace.null_tracer()
+    ckptr = pend["ckptr"]
+    with tr.span("ckpt_commit", step=pend["step"]) as sp:
+        sp.set(overlap_s=round(time.perf_counter() - pend["t_dispatch"], 4))
+        try:
+            ckptr.wait_until_finished()
+        except Exception as e:  # noqa: BLE001 — unverified dir, walk on
+            sp.set(error=type(e).__name__)
+            tr.event("ckpt_commit_failed", step=pend["step"], error=repr(e))
+            print(f"[checkpoint] async save at step {pend['step']} failed "
+                  f"to commit ({e!r}); {pend['path'].name} stays unverified")
+            _close_quiet(ckptr)
+            return None
+        _close_quiet(ckptr)
+        if dist.is_primary():
+            integrity.write_manifest(
+                pend["path"], step=pend["step"],
+                extra={"mesh_shape": pend["mesh_shape"]},
+            )
+    return pend["path"]
+
+
+def _close_quiet(ckptr) -> None:
+    try:
+        ckptr.close()
+    except Exception:  # noqa: BLE001 — the save outcome already decided
+        pass
 
 
 def _step_path(root: str | Path, step: int) -> Path:
@@ -68,14 +136,28 @@ def _step_dirs(root: Path) -> list[tuple[int, Path]]:
     )
 
 
-def save(root: str | Path, state: TrainState, force: bool = False) -> Path:
+def save(root: str | Path, state: TrainState, force: bool = False,
+         wait: bool = True, tracer=None) -> Path:
     """Write a sharded checkpoint at the state's current step, then
     commit it with a manifest (primary process). A dir without a
     manifest is, by definition, a save that never finished — restore's
-    walk-back will quarantine it."""
+    walk-back will quarantine it.
+
+    `wait=False` returns after the async dispatch (device arrays
+    snapshotted to host — safe even with buffer donation, which is why
+    training can keep mutating the state immediately): the disk write
+    streams out in the background and the manifest lands at the next
+    `wait_pending()` (called here first, so one save is in flight at a
+    time, and by every trainer exit path). The default `wait=True`
+    keeps the old synchronous contract: dispatch, commit, manifest,
+    return."""
+    global _PENDING
+    wait_pending(tracer=tracer)  # at most one save in flight
     step = int(state.step)
     path = _step_path(root, step)
     attempt = {"n": 0}
+    holder: dict = {}
+    tr = tracer or obs_trace.null_tracer()
 
     def _write():
         fault_point("ckpt_save")
@@ -84,15 +166,41 @@ def save(root: str | Path, state: TrainState, force: bool = False) -> Path:
         # didn't ask for one
         f = force or attempt["n"] > 0
         attempt["n"] += 1
-        with ocp.StandardCheckpointer() as ckptr:
+        ckptr = ocp.StandardCheckpointer()
+        try:
             ckptr.save(path, state, force=f)
+            if wait:
+                # synchronous contract: commit inside the retry scope,
+                # so a transient background-write failure retries the
+                # whole save exactly as the old close()-fenced path did
+                ckptr.wait_until_finished()
+        except BaseException:
+            _close_quiet(ckptr)
+            raise
+        holder["ckptr"] = ckptr
 
-    retry_call(_write, policy=IO_RETRY,
-               on_retry=lambda a, e, d: print(
-                   f"[checkpoint] save attempt {a + 1} failed ({e}); "
-                   f"retrying in {d:.2f}s"))
-    if dist.is_primary():
-        integrity.write_manifest(path, step=step, state=state)
+    with tr.span("ckpt_dispatch", step=step) as sp:
+        sp.set(wait=wait)
+        retry_call(_write, policy=IO_RETRY,
+                   on_retry=lambda a, e, d: print(
+                       f"[checkpoint] save attempt {a + 1} failed ({e}); "
+                       f"retrying in {d:.2f}s"))
+    if wait:
+        _close_quiet(holder["ckptr"])
+        with tr.span("ckpt_commit", step=step) as sp:
+            sp.set(overlap_s=0.0)
+            if dist.is_primary():
+                integrity.write_manifest(path, step=step, state=state)
+        return path
+    _PENDING = {
+        "ckptr": holder["ckptr"],
+        "path": path,
+        "step": step,
+        # provenance captured NOW: holding the state until commit would
+        # pin buffers the (donating) train step is about to reuse
+        "mesh_shape": integrity.mesh_shape_of(state),
+        "t_dispatch": time.perf_counter(),
+    }
     return path
 
 
@@ -161,6 +269,10 @@ def restore(
     prior step. Returns None when nothing restorable remains (fresh
     run). An explicit `step` is verified and restored with no fallback
     — the caller asked for those exact bytes, so failure raises."""
+    # an in-flight async save must commit before the walk scans the
+    # tree (same-process save->restore sequences would otherwise race
+    # the background write)
+    wait_pending(tracer=tracer)
     root = Path(root)
     if step is not None:
         path = _step_path(root, step)

@@ -21,13 +21,44 @@ the reference's launcher did (run_distributed.py:148-149).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
+from pathlib import Path
 
 from hyperion_tpu.config import Config
 from hyperion_tpu.metrics.scaling_report import create_scaling_report
 from hyperion_tpu.runtime import dist
 
 MODELS = ("language_ddp", "cifar", "language_fsdp", "llama", "all", "scaling")
+
+# persistent-compile-cache env knob: the --compile-cache flag wins;
+# supervised children inherit the env (and the flag rides their argv),
+# so a restart reloads the executable instead of recompiling it
+COMPILE_CACHE_ENV = "HYPERION_COMPILE_CACHE"
+
+
+def setup_compile_cache(cache_dir: str | None) -> str | None:
+    """Point jax's persistent compilation cache at `<dir>/<backend>`.
+
+    Applied IN-PROCESS via `jax.config.update` — never by mutating
+    `os.environ` (bench.py's import-time-leak postmortem: a mutated
+    parent env silently gifts a shared on-disk cache to every later
+    subprocess, and on this deployment's CPU backend reloading a cached
+    executable aborts the process). The per-backend subdir keeps a
+    laptop smoke run and a chip run from ever sharing cache entries on
+    top of XLA's own cache keying. Returns the resolved dir, or None
+    when no cache is configured."""
+    cache_dir = cache_dir or os.environ.get(COMPILE_CACHE_ENV, "")
+    if not cache_dir:
+        return None
+    import jax
+
+    d = Path(cache_dir).absolute() / jax.default_backend()
+    d.mkdir(parents=True, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(d))
+    if dist.is_primary():
+        print(f"[compile-cache] persistent XLA cache at {d}")
+    return str(d)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -111,6 +142,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default="",
                    help="capture a jax.profiler trace of the first epoch "
                         "into this directory (TensorBoard/XProf format)")
+    p.add_argument("--prefetch-depth", type=int, default=2,
+                   help="assemble batches this many steps ahead on a "
+                        "background thread so host input work overlaps "
+                        "device compute (semantics-neutral — identical "
+                        "batches in identical order; 0 = synchronous "
+                        "assembly on the critical path)")
+    p.add_argument("--no-async-checkpoint", action="store_true",
+                   help="make every checkpoint save block until the "
+                        "bytes are committed (default: saves stream out "
+                        "in the background while training continues; "
+                        "the integrity manifest is only written after "
+                        "the write finishes)")
+    p.add_argument("--compile-cache", default="",
+                   help="persistent XLA compilation cache directory "
+                        "(per-backend subdirs) so --supervise restarts "
+                        "and mid-epoch resumes skip the multi-minute "
+                        "train-step recompile; default: the "
+                        "HYPERION_COMPILE_CACHE env var, else off. The "
+                        "flag rides through to supervised children "
+                        "verbatim and is applied in-process (never by "
+                        "mutating the parent environment)")
     p.add_argument("--chaos", default="",
                    help="deterministic fault plan (testing/chaos.py): "
                         "comma-separated kill@step=N, sigterm@step=N, "
@@ -193,6 +245,8 @@ def make_config(args, job: str) -> Config:
     cfg.train.health_policy = args.health_policy
     cfg.train.dry_init = args.dry_init
     cfg.train.profile_dir = args.profile_dir
+    cfg.train.prefetch_depth = args.prefetch_depth
+    cfg.train.async_checkpoint = not args.no_async_checkpoint
     cfg.train.seed = args.seed
     cfg.train.lora = args.lora
     cfg.train.export_merged = args.export_merged
@@ -205,6 +259,7 @@ def make_config(args, job: str) -> Config:
     cfg.optimization.remat = args.remat or ("full" if needs_remat else "none")
     cfg.optimization.compile_tier = args.compile_tier
     cfg.optimization.attention_impl = args.attention_impl
+    cfg.optimization.compile_cache = args.compile_cache
     if job in ("language_fsdp", "llama"):
         cfg.optimization.grad_clip_norm = 1.0  # reference clip 1.0 (:351,522)
     cfg.distributed.max_devices = args.devices
@@ -287,6 +342,9 @@ def main(argv=None) -> int:
         return supervise(child, base_dir=args.base_dir,
                          max_restarts=args.max_restarts)
     dist.setup()
+    # after dist.setup (the backend is decided), before any compile:
+    # restarted/resumed runs reload the train-step executable from here
+    setup_compile_cache(args.compile_cache)
     rc = 0
 
     if args.model == "scaling":

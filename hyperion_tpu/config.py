@@ -52,6 +52,15 @@ class OptimizationConfig:
     compile_tier: str = "jit"        # jit | jit+pallas (compile_bench variants)
     attention_impl: str | None = None  # override just attention: xla | pallas
     donate_state: bool = True        # buffer donation into the train step
+    # persistent XLA compilation cache directory (cli/main.py resolves
+    # it to a per-backend subdir and points jax at it in-process —
+    # never by mutating the environment). Empty = the
+    # HYPERION_COMPILE_CACHE env var, else no persistent cache. With a
+    # cache, `--supervise` restarts and mid-epoch resumes skip the
+    # multi-minute train-step recompile. Caution: on this deployment's
+    # CPU backend reloading a cached executable can abort the process
+    # (the bench.py import-leak postmortem) — use on real chips.
+    compile_cache: str = ""
 
 
 @dataclasses.dataclass
@@ -97,6 +106,21 @@ class TrainConfig:
     train_split: str = "train"
     steps_per_epoch: int = 0         # 0 = full pass; >0 caps steps (smoke/bench runs)
     validate: bool = True            # per-epoch val pass (exceeds reference)
+    # input-pipeline overlap (data/prefetch.py): batches assembled this
+    # many steps ahead on a background thread, so host fancy-indexing +
+    # H2D transfer overlap device compute. Semantics-neutral (the
+    # prefetched run is batch-for-batch identical to the sync path);
+    # 0 = synchronous assembly on the critical path (the fallback
+    # switch, still timed for the input_wait_s gauge). Depth beyond 2-3
+    # only buys memory pressure: one worker can only assemble so far
+    # ahead of a consumer that drains the queue every step.
+    prefetch_depth: int = 2
+    # checkpoint saves stream to disk in the background while training
+    # continues (checkpoint/io.py wait_pending is the commit point: the
+    # integrity manifest lands only after the write finishes, so a kill
+    # mid-save can never yield a verified-but-partial dir). False =
+    # every save blocks until committed, the pre-overlap behavior.
+    async_checkpoint: bool = True
     # run telemetry (obs/): step spans + per-epoch metric snapshots to
     # <base_dir>/telemetry.jsonl (appended; primary process only). Reports
     # via `hyperion obs summarize`. HYPERION_TELEMETRY=0/path overrides.

@@ -1,3 +1,4 @@
 from hyperion_tpu.data.text import load_wikitext2, synthetic_lm_split, TextSplit  # noqa: F401
 from hyperion_tpu.data.vision import load_cifar10, synthetic_cifar_split, VisionSplit  # noqa: F401
 from hyperion_tpu.data.sharding import ShardedBatches  # noqa: F401
+from hyperion_tpu.data.prefetch import Prefetcher  # noqa: F401

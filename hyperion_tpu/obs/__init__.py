@@ -61,6 +61,7 @@ from hyperion_tpu.obs.registry import (  # noqa: F401
     compiled_flops,
     mfu_value,
     observe_device_memory,
+    observe_input_wait,
     observe_mfu,
     observe_step,
     observe_throughput,

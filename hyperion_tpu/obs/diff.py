@@ -41,6 +41,10 @@ METRICS: dict[str, str] = {
     "vs_baseline": "higher",
     "lm_step_ms": "lower",
     "lm_tokens_per_s": "higher",
+    # bench.py input_pipeline probe: host batch-assembly rates for the
+    # sync vs background-prefetched paths (data/prefetch.py)
+    "input_sync_batches_per_s": "higher",
+    "input_prefetch_batches_per_s": "higher",
 }
 
 
@@ -83,6 +87,15 @@ def normalize(doc: dict) -> dict[str, float]:
                 v = _num(extra.get(k))
                 if v is not None:
                     out[k] = v
+        pipe = doc.get("input_pipeline")
+        if isinstance(pipe, dict):
+            for src, name in (("sync_batches_per_s",
+                               "input_sync_batches_per_s"),
+                              ("prefetch_batches_per_s",
+                               "input_prefetch_batches_per_s")):
+                v = _num(pipe.get(src))
+                if v is not None:
+                    out[name] = v
     # trainer *_summary.json {"step_ms": ..., "peak_hbm_mb": ...}
     if "step_ms" in doc:
         v = _num(doc.get("step_ms"))

@@ -56,6 +56,12 @@ STALE_S = 300.0
 STALL_RATIO = 5.0
 _STALL_TAIL = 3          # steps averaged for the tail
 _STALL_MIN_STEPS = 6     # need a baseline before "slower than usual" means anything
+# input-bound threshold: when the step loop spent more than this
+# fraction of its last epoch blocked on the input queue (the
+# `input_wait_frac` gauge from `observe_input_wait`), the run is
+# data-starved — the fix is prefetch depth / faster input, not a
+# bigger chip
+INPUT_BOUND_FRAC = 0.5
 
 
 def locate(target: str | Path) -> tuple[Path, Path]:
@@ -166,10 +172,18 @@ def diagnose(
     last_wall = max(walls) if walls else None
 
     hbm_peak = None
+    input_frac = input_wait_s = None
     for s in snapshots:
-        p = s.get("metrics", {}).get("gauges", {}).get("hbm_peak_mb")
+        g = s.get("metrics", {}).get("gauges", {})
+        p = g.get("hbm_peak_mb")
         if p is not None:
             hbm_peak = p if hbm_peak is None else max(hbm_peak, p)
+        # input-wait evidence: the LAST epoch's snapshot wins (the
+        # question is "is it input-bound NOW", not "was it ever")
+        if isinstance(g.get("input_wait_frac"), (int, float)):
+            input_frac = float(g["input_wait_frac"])
+        if isinstance(g.get("input_wait_s"), (int, float)):
+            input_wait_s = float(g["input_wait_s"])
 
     # ---- stall signal: tail steps vs the run's own earlier median ----
     stall = None
@@ -258,6 +272,19 @@ def diagnose(
         verdict = "running"
         reason = "stream active, no terminal event yet"
 
+    # Orthogonal to liveness: a run can be perfectly healthy AND
+    # input-bound — compute idling while the host assembles batches.
+    # Appended to the reason (not a verdict of its own: the verdict
+    # taxonomy answers "is it alive", this answers "is it fed").
+    input_bound = input_frac is not None and input_frac >= INPUT_BOUND_FRAC
+    if input_bound and verdict in ("healthy", "running", "stalled"):
+        reason += (
+            f"; input-bound: {100 * input_frac:.0f}% of the last epoch "
+            "was spent blocked on the input pipeline "
+            f"({input_wait_s:.2f}s waiting)" if input_wait_s is not None
+            else f"; input-bound: input_wait_frac={input_frac:.2f}"
+        )
+
     last_span = spans[-1] if spans else None
     return {
         "target": str(target),
@@ -278,6 +305,9 @@ def diagnose(
             "p99": percentile(step_ms, 99),
         } if step_ms else None,
         "stall": stall,
+        "input_bound": input_bound,
+        "input_wait_frac": input_frac,
+        "input_wait_s": input_wait_s,
         "last_span": {
             "name": last_span.get("name"), "step": last_span.get("step"),
             "dur_ms": last_span.get("dur_ms"),
@@ -356,6 +386,11 @@ def render_markdown(d: dict) -> str:
         s = d["stall"]
         lines.append(f"| stall | tail {s['tail_mean_ms']} ms vs p50 "
                      f"{s['baseline_p50_ms']} ms ({s['ratio']}x) |")
+    if d.get("input_wait_frac") is not None:
+        flag = " — **input-bound**" if d.get("input_bound") else ""
+        lines.append(
+            f"| input wait | {100 * d['input_wait_frac']:.0f}% of the "
+            f"last epoch{flag} |")
     ls = d.get("last_span")
     if ls:
         where = f" (step {ls['step']})" if ls.get("step") is not None else ""

@@ -184,6 +184,20 @@ def observe_throughput(
         reg.gauge("samples_per_s").set(samples / duration_s)
 
 
+def observe_input_wait(
+    reg: MetricsRegistry, wait_s: float, window_s: float | None = None,
+) -> None:
+    """Time the step loop spent BLOCKED on the input queue over one
+    epoch window (`data.prefetch.Prefetcher.wait_s`), plus the
+    data-starved fraction of that window. Near-zero wait means the
+    prefetcher kept the device fed; a fraction approaching 1 means the
+    run is input-bound — compute idles while the host assembles batches
+    (`obs doctor` reads exactly this gauge to say so)."""
+    reg.gauge("input_wait_s").set(wait_s)
+    if window_s and window_s > 0:
+        reg.gauge("input_wait_frac").set(min(wait_s / window_s, 1.0))
+
+
 def observe_device_memory(reg: MetricsRegistry) -> None:
     """Allocator live/peak bytes as MB gauges; backends without
     `memory_stats` (the axon tunnel, CPU) report None, not 0 — absent

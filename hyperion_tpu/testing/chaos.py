@@ -157,6 +157,21 @@ class ChaosPlan:
                 continue
             print(f"[chaos] firing {f.key}", flush=True)
             if f.kind == "kill":
+                # Flush any in-flight ASYNC checkpoint save before the
+                # no-grace kill: the chaos contract is step-exact —
+                # "kill@step=N means steps 0..N-1 completed AND the
+                # epoch-boundary save before N is durable" — so the
+                # resume-equality tests stay deterministic instead of
+                # racing the background commit thread. The kill-DURING-
+                # the-save-window drill (which deliberately loses the
+                # uncommitted save) lives in tests/test_checkpoint_io.py
+                # where the window is held open on purpose.
+                try:
+                    from hyperion_tpu import checkpoint
+
+                    checkpoint.wait_pending()
+                except Exception:  # noqa: BLE001 — chaos must still fire
+                    pass
                 os.kill(os.getpid(), signal.SIGKILL)
             elif f.kind == "sigterm":
                 os.kill(os.getpid(), signal.SIGTERM)

@@ -32,6 +32,7 @@ import numpy as np
 
 from hyperion_tpu import checkpoint as ckpt
 from hyperion_tpu.config import Config
+from hyperion_tpu.data.prefetch import Prefetcher
 from hyperion_tpu.data.sharding import ShardedBatches
 from hyperion_tpu.data.text import load_wikitext2
 from hyperion_tpu.data.vision import load_cifar10
@@ -56,6 +57,7 @@ from hyperion_tpu.obs import (
     MetricsRegistry,
     compiled_flops,
     observe_device_memory,
+    observe_input_wait,
     observe_mfu,
     observe_step,
     observe_throughput,
@@ -162,16 +164,27 @@ def _sum_of(metric_stack: list[dict], key: str) -> float:
     return float(jnp.sum(jnp.stack([m[key] for m in metric_stack])))
 
 
-def _save_checkpoint(ckpt_dir: str, state, tag: str) -> None:
+def _save_checkpoint(ckpt_dir: str, state, tag: str, tracer=None,
+                     wait: bool = True) -> None:
     """Barrier-fenced sharded save + prune — the ONE implementation for
     both the epoch-boundary and preemption paths. Named host barriers
     fence the IO the way the reference bracketed FSDP checkpointing
     (distributed_utils.py:369,405) — and fail fast if a peer died.
     Checkpoint IO duration legitimately skews across hosts (slow shared
     storage), so the timeout is generous — the reference raised its
-    watchdog to 7200 s around exactly this IO."""
+    watchdog to 7200 s around exactly this IO.
+
+    `wait=False` (the epoch-boundary path under async_checkpoint)
+    returns after the async dispatch: the disk write streams out while
+    the next epoch trains, and the previous epoch's in-flight save is
+    committed (manifest written) by `ckpt.save`'s own wait_pending
+    before this one dispatches. The barrier then fences the DISPATCH —
+    the host-side array snapshot — which is all step-consistency
+    needs; the commit is fenced by the next save or a trainer exit.
+    Preemption/health paths keep `wait=True`: the process is about to
+    exit, so the save must be durable before control returns."""
     dist.host_barrier(f"pre_ckpt_{tag}", timeout_s=3600.0)
-    ckpt.save(ckpt_dir, state, force=True)
+    ckpt.save(ckpt_dir, state, force=True, wait=wait, tracer=tracer)
     ckpt.prune(ckpt_dir, keep=2)  # full sharded state per epoch adds up
     dist.host_barrier(f"post_ckpt_{tag}", timeout_s=3600.0)
 
@@ -206,7 +219,7 @@ def _health_react(
         anom = fired[-1]
         with tracer.span("checkpoint", reason=f"health_{anom.kind}"):
             _save_checkpoint(f"{ckpt_dir}/health", state,
-                             f"health_{anom.step}")
+                             f"health_{anom.step}", tracer=tracer)
     return action == "abort"
 
 
@@ -340,14 +353,26 @@ def _epoch_loop(
             # --profile-dir: capture a jax.profiler trace of the FIRST
             # epoch this run executes (SURVEY §5.1's idiomatic upgrade)
             profile_this = cfg.train.profile_dir and epoch == resume_epoch
-            with profiling.capture(
+            # background input prefetch (data/prefetch.py): batch N+1's
+            # host assembly + H2D overlap batch N's compute. FIRST in
+            # the `with` header so the statement owns the worker from
+            # the moment it starts — EVERY exit (preempt/abort break,
+            # exception, even a later manager's __enter__ failing)
+            # drains it before the save/export code below runs, keeping
+            # the stop-before-step boundary exact. wait_s outlives the
+            # close and feeds the input_wait_s gauge.
+            with Prefetcher(
+                batches.epoch(epoch, start),
+                depth=cfg.train.prefetch_depth,
+            ) as feed, profiling.capture(
                 cfg.train.profile_dir if profile_this else None
-            ), tracer.span("epoch", step=epoch * steps_per_epoch + start) \
-                    as ep_span:
+            ), tracer.span(
+                "epoch", step=epoch * steps_per_epoch + start
+            ) as ep_span:
                 t0 = time.perf_counter()
                 device_metrics = []
                 last_batch = None
-                for i, batch in enumerate(batches.epoch(epoch, start), start):
+                for i, batch in enumerate(feed, start):
                     if max_steps and i >= max_steps:
                         break
                     gstep = epoch * steps_per_epoch + i
@@ -431,6 +456,10 @@ def _epoch_loop(
                     reg, duration, len(device_metrics),
                     **{k: v * len(device_metrics) for k, v in thru_kw.items()},
                 )
+                # data-starved fraction: time the loop spent blocked on
+                # the input queue vs the fenced epoch wall — the number
+                # that says whether prefetch kept the device fed
+                observe_input_wait(reg, feed.wait_s, duration)
                 if not flops_known and last_batch is not None:
                     flops_per_step = compiled_flops(
                         train_step, state, last_batch, rng
@@ -459,7 +488,11 @@ def _epoch_loop(
                              steps_done=len(device_metrics))
                 hb.close(phase="preempted")
                 if ckpt_dir:
-                    _save_checkpoint(ckpt_dir, state, f"preempt_{epoch}")
+                    # wait=True: the process exits right after — the
+                    # preemption checkpoint must be durable, and any
+                    # prior epoch's in-flight save commits on the way
+                    _save_checkpoint(ckpt_dir, state, f"preempt_{epoch}",
+                                     tracer=tracer)
                 if dist.is_primary():
                     print(f"[{job}] preempted at global step {int(state.step)} "
                           f"(epoch {epoch + 1}); "
@@ -537,7 +570,12 @@ def _epoch_loop(
                          + len(device_metrics), phase="checkpoint",
                          epoch=epoch + 1)
                 with tracer.span("checkpoint", epoch=epoch + 1):
-                    _save_checkpoint(ckpt_dir, state, str(epoch))
+                    # async (default): dispatch only — the write streams
+                    # out while the next epoch trains; commit + manifest
+                    # land at the next save / trainer exit (wait_pending)
+                    _save_checkpoint(ckpt_dir, state, str(epoch),
+                                     tracer=tracer,
+                                     wait=not cfg.train.async_checkpoint)
             if stopping:
                 # signal arrived at the epoch's end: the epoch is fully
                 # trained, logged, and saved above — stop before starting
@@ -949,6 +987,10 @@ def train_language_model(cfg: Config, job: str = "language_ddp") -> TrainResult:
         eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
         tracer=tracer,
     )
+    # drain the in-flight async save on EVERY exit shape (completion,
+    # preemption, health abort) before exports or process exit — an
+    # uncommitted epoch-boundary save would otherwise be lost
+    ckpt.wait_pending(tracer=tracer)
     tracer.event("train_end", preempted=preempted, epochs_run=len(history))
     tracer.close()
     if not preempted:
@@ -1055,6 +1097,7 @@ def train_cifar_model(cfg: Config, job: str = "cifar_ddp") -> TrainResult:
         eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
         tracer=tracer,
     )
+    ckpt.wait_pending(tracer=tracer)  # commit any in-flight save first
     tracer.event("train_end", preempted=preempted, epochs_run=len(history))
     tracer.close()
     if not preempted:  # never clobber a final export with half an epoch
@@ -1232,6 +1275,7 @@ def train_llama(cfg: Config, job: str = "llama") -> TrainResult:
         eval_step=eval_step, eval_batches=val_batches, eval_cols=eval_cols,
         tracer=tracer,
     )
+    ckpt.wait_pending(tracer=tracer)  # commit any in-flight save first
     tracer.event("train_end", preempted=preempted, epochs_run=len(history))
     tracer.close()
     if dist.is_primary() and history and not preempted:

@@ -64,6 +64,8 @@ def run_main(bench, capsys) -> dict:
 
 GOOD_PROBE = {"ok": True, "platform": "tpu", "device_kind": "v5e"}
 CPU_PROBE = {"ok": False, "platform": "cpu", "device_kind": "cpu"}
+GOOD_PIPELINE = {"sync_batches_per_s": 300.0,
+                 "prefetch_batches_per_s": 360.0, "speedup": 1.2}
 GOOD_MEASUREMENT = {
     "tflops": 150.0, "per_iter_ms": 7.0, "amortized_ms": 7.0,
     "dispatch_overhead_ms": 60.0, "chain_lengths": [16, 48],
@@ -96,12 +98,14 @@ class TestBenchMain:
             "--child-matmul": (200, GOOD_MEASUREMENT, ""),
             "--child-lm-step": (100, {"lm_step_ms": 30.0,
                                       "lm_tokens_per_s": 1e5}, ""),
+            "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
         assert out["value"] == 150.0
         assert out["platform"] == "tpu"
         assert "extra" in out and "lm_step_ms" in out["extra"]
+        assert out["input_pipeline"]["speedup"] == 1.2
 
     def test_dead_tunnel_emits_failure_with_sanity(self, bench, clock,
                                                    capsys, monkeypatch):
@@ -111,6 +115,7 @@ class TestBenchMain:
             "--child-probe": (10_000, None, ""),
             "--child-matmul": (10_000, None, ""),
             "--child-cpu-sanity": (60, {"cpu_matmul_1024_tflops": 0.1}, ""),
+            "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -119,6 +124,9 @@ class TestBenchMain:
         # specific) timeout becomes the recorded error
         assert "timed out" in out["error"]
         assert out["cpu_sanity"]["cpu_matmul_1024_tflops"] == 0.1
+        # the chip-free input-pipeline row rides the failure line too,
+        # budget permitting — history stays continuous on dead rounds
+        assert "input_pipeline" in out
         # total simulated wall time stayed inside the deadline
         assert clock.t - 1000.0 <= bench.DEADLINE_S
 
@@ -129,6 +137,7 @@ class TestBenchMain:
         runner, calls = make_runner(bench, clock, {
             "--child-probe": (20, CPU_PROBE, ""),
             "--child-cpu-sanity": (60, {"cpu_matmul_1024_tflops": 0.1}, ""),
+            "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -168,6 +177,7 @@ class TestBenchMain:
             "--child-probe": (30, GOOD_PROBE, ""),
             "--child-matmul": (200, GOOD_MEASUREMENT, ""),
             "--child-lm-step": (100, {"lm_step_ms": 30.0}, ""),
+            "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -176,7 +186,8 @@ class TestBenchMain:
                  for line in tele.read_text().splitlines()]
         assert names[0] == "bench_start"
         for expected in ("probe_attempt", "probe_result",
-                         "measure_attempt", "measure_result", "publish"):
+                         "measure_attempt", "measure_result",
+                         "input_pipeline", "publish"):
             assert expected in names, names
         publish = [json.loads(line)
                    for line in tele.read_text().splitlines()][-1]
@@ -191,6 +202,7 @@ class TestBenchMain:
             "--child-probe": (10_000, None, ""),
             "--child-matmul": (10_000, None, ""),
             "--child-cpu-sanity": (10_000, None, ""),
+            "--child-input-pipeline": (10_000, None, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)

@@ -133,6 +133,27 @@ class TestFiring:
         plan2.on_step(2)
         assert sent == [signal.SIGTERM]
 
+    def test_kill_flushes_inflight_async_save_first(self, tmp_path,
+                                                    monkeypatch):
+        """The chaos step contract is exact: kill@step=N means steps
+        0..N-1 completed AND the epoch-boundary save before N is
+        durable — so the kill must flush the in-flight ASYNC save
+        before firing, instead of racing the background commit thread
+        (the kill-DURING-the-save-window drill lives in
+        test_checkpoint_io.py, where the window is held open on
+        purpose)."""
+        from hyperion_tpu.checkpoint import io as ckpt_io
+
+        flushed = []
+        # chaos resolves checkpoint.wait_pending lazily (PEP 562), so
+        # patching the io module is what its call actually hits
+        monkeypatch.setattr(ckpt_io, "wait_pending",
+                            lambda tracer=None: flushed.append(True))
+        monkeypatch.setattr(chaos.os, "kill", lambda pid, sig: None)
+        plan = chaos.ChaosPlan(chaos.parse_plan("kill@step=2"))
+        plan.on_step(2)
+        assert flushed == [True]
+
     def test_mark_precedes_execution(self, tmp_path, monkeypatch):
         """SIGKILL never returns: the fire record must be on disk BEFORE
         the fault executes."""

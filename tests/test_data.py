@@ -60,6 +60,53 @@ class TestTextPipeline:
         assert d2["train"].source.startswith("npz:")
         assert len(d2["train"]) == 8
 
+    def test_synthetic_seed_to_corpus_mapping_is_pinned(self):
+        """The inverse-CDF sampler must keep the exact draw the old
+        `rng.choice(..., p=probs)` produced (numpy's Generator builds
+        the same renormalized cdf + side='right' search internally) —
+        this pins the seed -> corpus mapping so any future sampler
+        change that silently reshuffles every fixture fails HERE."""
+        s = synthetic_lm_split(4, seq_len=8, seed=42)
+        np.testing.assert_array_equal(
+            s.input_ids[0],
+            np.array([994, 19, 3633, 350, 50256, 50256, 50256, 50256],
+                     np.int32),
+        )
+        assert int(s.input_ids.sum()) == 658217
+
+    def test_ragged_arrow_scatter_matches_per_row_reference(self, tmp_path):
+        """Variable-length list columns (no padding on disk): the
+        vectorized mask scatter must reproduce the old per-row copy
+        loop byte for byte, including the zero right-fill."""
+        import pyarrow as pa
+        import pyarrow.ipc as ipc
+
+        rng = np.random.default_rng(5)
+        ids = [rng.integers(0, 1000, size=n).tolist()
+               for n in (3, 7, 1, 5, 7, 2)]
+        mask = [[1] * len(row) for row in ids]
+        table = pa.table({
+            "input_ids": pa.array(ids, type=pa.list_(pa.int32())),
+            "attention_mask": pa.array(mask, type=pa.list_(pa.int8())),
+        })
+        split_dir = tmp_path / "ragged"
+        split_dir.mkdir(parents=True)
+        with ipc.new_stream(str(split_dir / "data-00000-of-00001.arrow"),
+                            table.schema) as w:
+            w.write_table(table)
+        from hyperion_tpu.data.text import load_arrow_split
+
+        s = load_arrow_split(split_dir)
+        width = max(len(r) for r in ids)
+        expected = np.zeros((len(ids), width), np.int32)
+        for i, row in enumerate(ids):  # the old loop, as the oracle
+            expected[i, : len(row)] = row
+        np.testing.assert_array_equal(s.input_ids, expected)
+        expected_mask = np.zeros((len(ids), width), np.int8)
+        for i, row in enumerate(mask):
+            expected_mask[i, : len(row)] = row
+        np.testing.assert_array_equal(s.attention_mask, expected_mask)
+
     def test_arrow_reader_against_reference_format(self, tmp_path):
         # Write an HF-datasets-style arrow stream file and read it back.
         import pyarrow as pa

@@ -60,6 +60,21 @@ def write_run(path, run: str, step_ms: float, *, steps: int = 8,
     t.close()
 
 
+def write_input_wait_run(path, run: str, frac: float, wait_s: float = 8.0):
+    """A finished run whose last snapshot carries the input-wait gauges
+    (`observe_input_wait`) — the evidence `doctor` reads for the
+    input-bound call."""
+    clk, wall = FakeClock(100.0), FakeClock(1_000.0)
+    t = Tracer(path, run=run, proc=0, clock=clk, wall=wall)
+    t.event("train_start", job="language_ddp")
+    reg = MetricsRegistry()
+    reg.gauge("input_wait_s").set(wait_s)
+    reg.gauge("input_wait_frac").set(frac)
+    t.snapshot(reg, step=8)
+    t.event("train_end", preempted=False)
+    t.close()
+
+
 # --------------------------------------------------------------- doctor
 
 
@@ -187,6 +202,37 @@ class TestDoctorFixtures:
         d = doctor.diagnose(tmp_path, run="r_old", now=5_000.0)
         assert d["heartbeat"] is None
         assert d["verdict"] == "hung"  # stream stale, no heartbeat for it
+
+
+class TestInputBound:
+    """`obs doctor` calls a run input-bound when the input_wait_frac
+    gauge says the step loop mostly waited on the input queue — an
+    orthogonal note on the liveness verdict, not a verdict itself."""
+
+    def test_flags_input_bound_run(self, tmp_path):
+        write_input_wait_run(tmp_path / "telemetry.jsonl", "r1", frac=0.8)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["verdict"] == "healthy"  # alive AND starved can coexist
+        assert d["input_bound"] is True
+        assert d["input_wait_frac"] == 0.8
+        assert "input-bound" in d["reason"]
+        assert "input wait" in doctor.render_markdown(d)
+        assert "**input-bound**" in doctor.render_markdown(d)
+
+    def test_well_fed_run_stays_quiet(self, tmp_path):
+        write_input_wait_run(tmp_path / "telemetry.jsonl", "r1", frac=0.04)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["input_bound"] is False
+        assert "input-bound" not in d["reason"]
+        # the evidence row still renders, unflagged
+        assert "input wait" in doctor.render_markdown(d)
+
+    def test_no_gauge_means_no_claim(self, tmp_path):
+        write_run(tmp_path / "telemetry.jsonl", "r1", 10.0)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["input_bound"] is False
+        assert d["input_wait_frac"] is None
+        assert "input wait" not in doctor.render_markdown(d)
 
 
 # -------------------------------------------------- telemetry contract
@@ -319,6 +365,19 @@ class TestDiff:
         assert m["headline_tflops"] == 175.75
         assert m["vs_baseline"] == 1.452
         assert m["lm_step_ms"] == 61.9
+
+    def test_normalize_input_pipeline_probe(self):
+        """bench.py's input_pipeline row rides the standard bench shape,
+        so `obs diff --history` tracks it across BENCH_r*.json."""
+        m = obs_diff.normalize({
+            "metric": "matmul_bf16_8192_tflops", "value": 100.0,
+            "input_pipeline": {"sync_batches_per_s": 376.6,
+                               "prefetch_batches_per_s": 434.2,
+                               "speedup": 1.15},
+        })
+        assert m["input_sync_batches_per_s"] == 376.6
+        assert m["input_prefetch_batches_per_s"] == 434.2
+        assert obs_diff.METRICS["input_prefetch_batches_per_s"] == "higher"
 
     def test_normalize_round_wrapper_and_trainer_summary(self):
         m = obs_diff.normalize({"rc": 0, "parsed": {

@@ -1,0 +1,171 @@
+"""data/prefetch.py + the trainer's overlap wiring.
+
+The tentpole contract under test: a prefetched run is batch-for-batch
+(and final-state) IDENTICAL to the sync path, worker exceptions
+propagate to the consumer, close() drains cleanly from any exit, and a
+smoke training run's telemetry carries the `input_wait_s` gauge plus
+the async-checkpoint `ckpt_dispatch`/`ckpt_commit` span pair.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from hyperion_tpu.data.prefetch import Prefetcher
+from hyperion_tpu.data.sharding import ShardedBatches
+from hyperion_tpu.data.text import synthetic_lm_split
+
+
+class TestPrefetcherUnit:
+    def test_forwards_items_in_order(self):
+        assert list(Prefetcher(iter(range(50)), depth=3)) == list(range(50))
+
+    def test_depth_zero_is_threadless_passthrough(self):
+        p = Prefetcher(iter(range(5)), depth=0)
+        assert p._thread is None  # the one-switch sync fallback
+        assert list(p) == list(range(5))
+        assert p.wait_s >= 0.0  # the sync path is still timed
+
+    def test_none_is_a_legal_item(self):
+        assert list(Prefetcher(iter([None, 1, None]), depth=2)) == \
+            [None, 1, None]
+
+    def test_worker_exception_propagates_after_queued_items(self):
+        """A fault mid-stream must surface in the CONSUMER thread, at
+        the point the failed batch would have arrived — never die
+        silently in the worker."""
+
+        def gen():
+            yield 1
+            yield 2
+            raise OSError("storage blip in the worker")
+
+        got = []
+        with pytest.raises(OSError, match="storage blip"):
+            for x in Prefetcher(gen(), depth=1):
+                got.append(x)
+        assert got == [1, 2]
+
+    def test_close_unblocks_a_worker_stuck_on_a_full_queue(self):
+        produced = []
+
+        def gen():
+            for i in range(100_000):
+                produced.append(i)
+                yield i
+
+        p = Prefetcher(gen(), depth=2)
+        assert next(p) == 0
+        deadline = time.monotonic() + 5.0
+        while len(produced) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)  # let the worker fill the queue and block
+        p.close()
+        assert not p._thread.is_alive()
+        assert len(produced) < 100_000  # stopped mid-stream, not drained
+        p.close()  # idempotent
+
+    def test_wait_s_accumulates_when_producer_is_slow(self):
+        def slow_gen():
+            for i in range(3):
+                time.sleep(0.02)
+                yield i
+
+        p = Prefetcher(slow_gen(), depth=2)
+        assert list(p) == [0, 1, 2]
+        assert p.wait_s > 0.0
+
+    def test_chaos_data_iter_fault_reaches_the_main_thread(self, mesh8):
+        """The `fault_point("data_iter")` seam fires inside the WORKER
+        once batches assemble ahead — the injected OSError must still
+        reach the consuming loop."""
+        from hyperion_tpu.testing import chaos
+        from hyperion_tpu.utils import retry as retry_mod
+
+        split = synthetic_lm_split(64, seq_len=8, seed=0)
+        batches = ShardedBatches(split.arrays(), 16, mesh8, seed=0)
+        plan = chaos.ChaosPlan(chaos.parse_plan("io_fail@p=1"))
+        retry_mod.set_fault_injector(plan.io_fail)
+        try:
+            with pytest.raises(OSError, match="injected io_fail"):
+                with Prefetcher(batches.epoch(0), depth=2) as feed:
+                    list(feed)
+        finally:
+            retry_mod.set_fault_injector(None)
+
+    def test_prefetched_epoch_identical_to_sync(self, mesh8):
+        """Same seeded permutation, batch for batch — the
+        semantics-neutrality half of the contract, at the data layer."""
+        split = synthetic_lm_split(48, seq_len=8, seed=3)
+        batches = ShardedBatches(split.arrays(), 16, mesh8, seed=7)
+        sync = [np.asarray(b["input_ids"]) for b in batches.epoch(2)]
+        with Prefetcher(batches.epoch(2), depth=3) as feed:
+            prefetched = [np.asarray(b["input_ids"]) for b in feed]
+        assert len(sync) == len(prefetched) == 3
+        for a, b in zip(sync, prefetched):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestTrainerOverlapE2E:
+    """Acceptance: a prefetched training run is bit-identical to the
+    sync run, and the telemetry stream carries the new overlap
+    evidence."""
+
+    def _run(self, base_dir, depth, telemetry=False):
+        from hyperion_tpu.config import Config
+        from hyperion_tpu.train.trainer import train_language_model
+
+        cfg = Config()
+        cfg.train.epochs = 2
+        cfg.train.batch_size = 16
+        cfg.train.seq_len = 16
+        cfg.train.steps_per_epoch = 3
+        cfg.train.learning_rate = 1e-3
+        cfg.train.validate = False
+        cfg.train.telemetry = telemetry
+        cfg.train.prefetch_depth = depth
+        cfg.train.base_dir = str(base_dir)
+        return train_language_model(cfg)
+
+    def test_prefetched_run_bit_identical_and_telemetry_complete(
+        self, tmp_path, mesh_dp, monkeypatch
+    ):
+        monkeypatch.delenv("HYPERION_TELEMETRY", raising=False)
+        sync = self._run(tmp_path / "sync", depth=0)
+        pre = self._run(tmp_path / "pre", depth=2, telemetry=True)
+
+        # batch-for-batch identical schedule => identical loss history
+        # and a bit-identical final export
+        assert [h.loss for h in sync.history] == [h.loss for h in pre.history]
+        a = np.load(tmp_path / "sync" / "checkpoints"
+                    / "language_ddp_final.npz")
+        b = np.load(tmp_path / "pre" / "checkpoints"
+                    / "language_ddp_final.npz")
+        assert a.files == b.files
+        for k in a.files:
+            np.testing.assert_array_equal(a[k], b[k])
+
+        # the async epoch-boundary saves all committed (manifest after
+        # wait_until_finished) before the exports ran
+        from hyperion_tpu import checkpoint as ckpt
+        from hyperion_tpu.checkpoint import integrity
+
+        job_dir = tmp_path / "pre" / "checkpoints" / "language_ddp_8dev"
+        step = ckpt.latest_step(job_dir)
+        assert step == 6  # 2 epochs x 3 steps
+        assert integrity.verify(job_dir / f"step_{step:08d}")[0]
+
+        # telemetry acceptance: input_wait_s gauge + the span pair
+        records = [json.loads(line) for line in
+                   (tmp_path / "pre" / "telemetry.jsonl").open()]
+        gauges = [r["metrics"]["gauges"] for r in records
+                  if r.get("kind") == "snapshot"]
+        assert gauges and all("input_wait_s" in g for g in gauges)
+        assert any(g.get("input_wait_frac") is not None for g in gauges)
+        span_names = {r["name"] for r in records if r.get("kind") == "span"}
+        assert {"ckpt_dispatch", "ckpt_commit"} <= span_names
+        # the commit half carries the overlap evidence
+        commits = [r for r in records if r.get("kind") == "span"
+                   and r["name"] == "ckpt_commit"]
+        assert all("overlap_s" in c for c in commits)

@@ -232,6 +232,68 @@ class TestSuperviseFlagStripping:
             "--model", "llama", "--epochs", "2"]
         assert _strip_supervise_flags(["--max-restarts=3", "--supervise"]) == []
 
+    def test_compile_cache_flag_rides_through_to_children(self):
+        """Supervised children re-exec the same argv minus supervision
+        flags — --compile-cache must survive so each restart points
+        itself (in-process, per backend) at the shared cache and skips
+        the recompile."""
+        from hyperion_tpu.cli.main import _strip_supervise_flags
+
+        argv = ["--model", "llama", "--supervise",
+                "--compile-cache", "/tmp/cc", "--max-restarts", "2"]
+        assert _strip_supervise_flags(argv) == [
+            "--model", "llama", "--compile-cache", "/tmp/cc"]
+
+
+class TestCompileCache:
+    def test_per_backend_subdir_and_in_process_config(self, tmp_path,
+                                                      monkeypatch):
+        import jax
+
+        from hyperion_tpu.cli.main import setup_compile_cache
+
+        monkeypatch.delenv("HYPERION_COMPILE_CACHE", raising=False)
+        before = dict(os.environ)
+        assert setup_compile_cache("") is None  # off by default
+        d = setup_compile_cache(str(tmp_path / "cache"))
+        try:
+            assert d == str(tmp_path / "cache" / "cpu")
+            assert (tmp_path / "cache" / "cpu").is_dir()
+            assert jax.config.jax_compilation_cache_dir == d
+            # the import-leak lesson: configuration is in-process only,
+            # never a mutated environment later children would inherit
+            assert dict(os.environ) == before
+        finally:
+            jax.config.update("jax_compilation_cache_dir", None)
+
+    def test_env_var_fallback(self, tmp_path, monkeypatch):
+        import jax
+
+        from hyperion_tpu.cli.main import setup_compile_cache
+
+        monkeypatch.setenv("HYPERION_COMPILE_CACHE",
+                           str(tmp_path / "envcache"))
+        try:
+            d = setup_compile_cache("")
+            assert d and (tmp_path / "envcache" / "cpu").is_dir()
+        finally:
+            jax.config.update("jax_compilation_cache_dir", None)
+
+    def test_cli_threads_overlap_knobs(self):
+        from hyperion_tpu.cli.main import build_parser, make_config
+
+        args = build_parser().parse_args([
+            "--model", "llama", "--prefetch-depth", "4",
+            "--no-async-checkpoint", "--compile-cache", "/tmp/cc"])
+        cfg = make_config(args, "llama")
+        assert cfg.train.prefetch_depth == 4
+        assert cfg.train.async_checkpoint is False
+        assert cfg.optimization.compile_cache == "/tmp/cc"
+        # defaults: prefetch on at depth 2, async saves on
+        dflt = make_config(build_parser().parse_args([]), "language_ddp")
+        assert dflt.train.prefetch_depth == 2
+        assert dflt.train.async_checkpoint is True
+
 
 def test_exit_code_contract():
     """scripts/tpu_watch.sh branches on these — they are API."""
