@@ -26,9 +26,12 @@ Robustness: measurements run in bounded subprocesses so a hung backend
 cannot hang the driver; failures still print ONE parseable JSON line.
 
 Secondary rows riding the same line: `extra` (GPT-2 LM train-step
-throughput) and `input_pipeline` (host batch-assembly rate, sync vs
-background-prefetched — chip-free, so it is attached to failure lines
-too and `obs diff --history` tracks it across BENCH_r*.json).
+throughput), `input_pipeline` (host batch-assembly rate, sync vs
+background-prefetched), and `serving` (the continuous-batching engine
+under a seeded Poisson load — tokens/sec, TTFT p50/p99, reject rate;
+serve/loadgen.py). The latter two are chip-free, so they are attached
+to failure lines too and `obs diff --history` tracks them across
+BENCH_r*.json.
 
 Telemetry: the probe/retry/deadline lifecycle additionally streams as
 `obs` events (probe_attempt, probe_result, measure_attempt,
@@ -280,6 +283,39 @@ def _child_input_pipeline() -> None:
     }))
 
 
+def _child_serving() -> None:
+    """Serving probe: the continuous-batching engine (serve/engine.py)
+    on the host backend under a seeded Poisson load (mixed prompt
+    lengths, serve/loadgen.py), reporting the user-facing SLOs —
+    tokens/sec, TTFT p50/p99, reject rate. Chip-free like the
+    input_pipeline probe (the parent forces JAX_PLATFORMS=cpu), so the
+    row survives dead-tunnel rounds and `obs diff` gates serving
+    regressions like any other metric. The tiny queue capacity is
+    deliberate: a probe that never rejects can't regress on
+    backpressure."""
+    import jax
+
+    from hyperion_tpu.models.llama import Llama, llama_tiny_config
+    from hyperion_tpu.serve.engine import Engine, EngineConfig
+    from hyperion_tpu.serve.loadgen import LoadSpec, run_load
+
+    cfg = llama_tiny_config(max_len=64)
+    model = Llama(cfg)
+    params = model.init_params(jax.random.key(0), seq=8)
+    engine = Engine(
+        model, {"params": params},
+        EngineConfig(slots=4, max_len=64, eos_id=None,
+                     queue_capacity=8, prefill_budget=64),
+    )
+    spec = LoadSpec(n_requests=32, rate_hz=100.0,
+                    prompt_lens=(4, 8, 16), max_new=(4, 8, 12),
+                    vocab=cfg.vocab_size, seed=0)
+    engine.warmup(list(spec.prompt_lens))
+    report = run_load(engine, spec)
+    report["compile"] = engine.compile_stats()
+    print(json.dumps(report))
+
+
 def _child_cpu_sanity() -> None:
     """The SAME measurement harness on the host CPU backend at small N.
     When the live value is 0.0 this row proves the harness itself works
@@ -403,6 +439,27 @@ def _add_input_pipeline(out: dict, hb, tracer, remaining) -> None:
     out["input_pipeline"] = pipe if pipe is not None else {"error": perr}
     tracer.event("input_pipeline", ok=pipe is not None, error=perr or None,
                  speedup=(pipe or {}).get("speedup"))
+
+
+def _add_serving(out: dict, hb, tracer, remaining) -> None:
+    """Attach the host-backend serving probe row (continuous-batching
+    engine under Poisson load, `--child-serving`). Chip-free, so it
+    rides BOTH the success and the dead-tunnel failure line — serving
+    SLO trajectories stay continuous across rounds either way."""
+    if remaining() < 60:
+        out["serving"] = {"error": "deadline reached; skipped"}
+        tracer.event("deadline", where="serving",
+                     remaining_s=round(remaining(), 1))
+        return
+    hb.pulse(phase="serving")
+    srv, serr = _run_child(
+        "--child-serving", int(min(180, remaining() - 30)),
+        env={"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+    )
+    out["serving"] = srv if srv is not None else {"error": serr}
+    tracer.event("serving", ok=srv is not None, error=serr or None,
+                 tokens_per_s=(srv or {}).get("tokens_per_s"),
+                 reject_rate=(srv or {}).get("reject_rate"))
 
 
 def main() -> None:
@@ -578,6 +635,7 @@ def main() -> None:
                 "capture, NOT a live number"
             )
         _add_input_pipeline(out, hb, tracer, remaining)
+        _add_serving(out, hb, tracer, remaining)
         tracer.event("publish", value=0.0, failed=True, error=err)
         hb.close(phase="done", value=0.0)
         tracer.close()
@@ -632,6 +690,7 @@ def main() -> None:
     else:
         out["extra"] = {"error": "deadline reached; skipped"}
     _add_input_pipeline(out, hb, tracer, remaining)
+    _add_serving(out, hb, tracer, remaining)
     tracer.event("publish", value=out["value"], plausible=plausible,
                  vs_baseline=out["vs_baseline"])
     hb.close(phase="done", value=out["value"])
@@ -648,6 +707,8 @@ if __name__ == "__main__":
         _child_probe()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child-input-pipeline":
         _child_input_pipeline()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--child-serving":
+        _child_serving()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child-cpu-sanity":
         _child_cpu_sanity()
     else:

@@ -327,6 +327,12 @@ def main(argv=None) -> int:
         from hyperion_tpu.obs.report import main as obs_main
 
         return obs_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # continuous-batching inference server (`hyperion serve --ckpt
+        # ...` — serve/server.py owns its full arg surface)
+        from hyperion_tpu.serve.server import main as serve_main
+
+        return serve_main(argv[1:])
     p = build_parser()
     args = p.parse_args(argv)
     if args.dry_init and args.model == "scaling":

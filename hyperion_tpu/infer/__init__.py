@@ -5,5 +5,6 @@ from hyperion_tpu.infer.generate import (  # noqa: F401
     generate,
     generate_recompute,
     sample_token,
+    sample_token_slots,
 )
 from hyperion_tpu.infer.speculative import generate_speculative  # noqa: F401

@@ -35,6 +35,32 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _mask_top_k(logits: jax.Array, top_k: int) -> jax.Array:
+    """Batch-uniform top-k restriction (static k): everything below the
+    k-th largest logit per row becomes -inf."""
+    kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _mask_top_p(logits: jax.Array, top_p) -> jax.Array:
+    """Nucleus restriction: keep the smallest prefix of the sorted
+    distribution whose mass reaches top_p (the first token always
+    survives: its preceding cumulative mass is 0 < top_p). `top_p` may
+    be a python float (batch-uniform) or a [B, 1] array (per-row —
+    the serve engine's per-slot sampling params); p = 1.0 rows are an
+    exact no-op: every kept value scatters back unchanged."""
+    order = jnp.argsort(-logits, axis=-1)
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    mass_before = jnp.cumsum(probs, axis=-1) - probs
+    kept = jnp.where(mass_before < top_p, sorted_logits, -jnp.inf)
+    # scatter back through the permutation already in hand (a second
+    # argsort would re-sort the full vocab every decode tick)
+    return jnp.full_like(logits, -jnp.inf).at[
+        jnp.arange(logits.shape[0])[:, None], order
+    ].set(kept)
+
+
 def sample_token(logits: jax.Array, rng: jax.Array | None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0) -> jax.Array:
@@ -47,25 +73,50 @@ def sample_token(logits: jax.Array, rng: jax.Array | None,
         raise ValueError("temperature > 0 sampling needs an rng key")
     logits = logits / temperature
     if top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
+        logits = _mask_top_k(logits, top_k)
     if top_p < 1.0:
         if top_p <= 0.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-        # nucleus: keep the smallest prefix of the sorted distribution
-        # whose mass reaches top_p (the first token always survives:
-        # its preceding cumulative mass is 0 < top_p)
-        order = jnp.argsort(-logits, axis=-1)
-        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        mass_before = jnp.cumsum(probs, axis=-1) - probs
-        kept = jnp.where(mass_before < top_p, sorted_logits, -jnp.inf)
-        # scatter back through the permutation already in hand (a second
-        # argsort would re-sort the full vocab every decode tick)
-        logits = jnp.full_like(logits, -jnp.inf).at[
-            jnp.arange(logits.shape[0])[:, None], order
-        ].set(kept)
+        logits = _mask_top_p(logits, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_token_slots(
+    logits: jax.Array,
+    keys: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """Per-row sampling for the serve engine: logits [S, V] with
+    per-slot params (each [S]) → token ids [S], one fully vectorized
+    call per decode tick — no per-slot python dispatch, no recompile
+    when the mix of sampling params changes across slot refills.
+
+    Row semantics match `sample_token` applied per row: temperature
+    <= 0 rows are greedy (argmax — their key is never consumed, so the
+    temp-0 oracle vs `generate` holds bit-exactly); positive rows
+    rescale, restrict support by that row's top_k (0 = off; dynamic per
+    row, so the k-th threshold comes from a full sort rather than
+    `lax.top_k`) then top_p (1.0 = an exact no-op), and draw with that
+    row's key. `keys` is a [S] typed PRNG key array."""
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = temperature.astype(logits.dtype)
+    scaled = logits / jnp.where(t > 0, t, 1.0)[:, None]
+    # per-row top-k: threshold = the clip(k-1)-th value of the row
+    # sorted descending, applied only where k > 0
+    k = jnp.clip(top_k, 0, V)
+    sorted_desc = -jnp.sort(-scaled, axis=-1)
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(k - 1, 0, V - 1)[:, None], axis=-1
+    )
+    restricted = jnp.where(
+        (k > 0)[:, None] & (scaled < kth), -jnp.inf, scaled
+    )
+    restricted = _mask_top_p(restricted, top_p[:, None])
+    sampled = jax.vmap(jax.random.categorical)(keys, restricted)
+    return jnp.where(t > 0, sampled.astype(jnp.int32), greedy)
 
 
 def _cfg_attr(cfg, name: str):

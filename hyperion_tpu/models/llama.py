@@ -173,11 +173,19 @@ def rope_frequencies(head_dim: int, max_len: int, theta: float) -> jax.Array:
 def apply_rope(x: jax.Array, table: jax.Array, offset=0) -> jax.Array:
     """Rotate [B, T, H, D] by the fp32 cos/sin table rows
     offset..offset+T (offset may be a traced scalar — decode steps slide
-    the window as the KV cache fills)."""
+    the window as the KV cache fills — or a [B] vector of per-row
+    offsets: the serve engine's slots each sit at their own depth)."""
     T = x.shape[1]
-    rows = jax.lax.dynamic_slice_in_dim(table, offset, T, axis=0)
-    cos = rows[:, :, 0][None, :, None, :]  # [1, T, 1, D/2]
-    sin = rows[:, :, 1][None, :, None, :]
+    if getattr(offset, "ndim", 0) >= 1:
+        rows = jax.vmap(
+            lambda o: jax.lax.dynamic_slice_in_dim(table, o, T, axis=0)
+        )(offset)                          # [B, T, D/2, 2]
+        cos = rows[..., 0][:, :, None, :]  # [B, T, 1, D/2]
+        sin = rows[..., 1][:, :, None, :]
+    else:
+        rows = jax.lax.dynamic_slice_in_dim(table, offset, T, axis=0)
+        cos = rows[:, :, 0][None, :, None, :]  # [1, T, 1, D/2]
+        sin = rows[:, :, 1][None, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
     return out.astype(x.dtype)
@@ -187,7 +195,9 @@ def _grouped_cache_attention(q, ck, cv, mask, rep):
     """Decode attention over the KV cache without materializing
     repeated K/V for GQA: the query's head axis folds into (kv_head,
     group) and the group rides the einsum. q [B, T, H, D]; ck/cv
-    [B, S, Hkv, D]; mask [T, S] (True = attend)."""
+    [B, S, Hkv, D]; mask [T, S] shared across the batch, or [B, T, S]
+    per-row (the serve engine's slots each mask to their own filled
+    prefix). True = attend."""
     from hyperion_tpu.ops.attention import NEG_INF
 
     B, T, H, D = q.shape
@@ -197,7 +207,9 @@ def _grouped_cache_attention(q, ck, cv, mask, rep):
     logits = jnp.einsum(
         "btgrd,bsgd->bgrts", qf * scale, ck.astype(jnp.float32)
     )
-    logits = jnp.where(mask[None, None, None], logits, NEG_INF)
+    mask = mask[None, None, None] if mask.ndim == 2 \
+        else mask[:, None, None]  # → broadcastable over [B, g, r, T, S]
+    logits = jnp.where(mask, logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bgrts,bsgd->btgrd", weights, cv.astype(jnp.float32))
     return out.reshape(B, T, H, D).astype(q.dtype)
@@ -213,7 +225,9 @@ class LlamaAttention(nn.Module):
         tokens already filled → (out, updated cache); the T new
         positions are written at cache_index and attention runs over
         the filled prefix (dense left-to-right prompts only — no
-        padding_mask in the cached path)."""
+        padding_mask in the cached path). `cache_index` may be a [B]
+        vector of per-row depths (the serve engine's slots decode
+        independent requests from one batched cache)."""
         c = self.cfg
         dense = _dense_ctor(c)
         q = dense(features=(c.n_heads, c.head_dim), name="q_proj")(x)
@@ -226,22 +240,41 @@ class LlamaAttention(nn.Module):
 
         if cache is not None:
             T = x.shape[1]
-            ck = jax.lax.dynamic_update_slice(
-                cache["k"], k.astype(cache["k"].dtype), (0, cache_index, 0, 0)
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cache["v"], v.astype(cache["v"].dtype), (0, cache_index, 0, 0)
-            )
+            if getattr(cache_index, "ndim", 0) >= 1:
+                # per-row offsets (serve engine: each slot at its own
+                # depth): batched scatter of the T new positions at
+                # row b's cache_index[b], and a per-row causal mask
+                B = x.shape[0]
+                rows = jnp.arange(B)[:, None]
+                cols = cache_index[:, None] + jnp.arange(T)[None, :]
+                ck = cache["k"].at[rows, cols].set(
+                    k.astype(cache["k"].dtype))
+                cv = cache["v"].at[rows, cols].set(
+                    v.astype(cache["v"].dtype))
+                S = ck.shape[1]
+                kv_pos = jax.lax.broadcasted_iota(jnp.int32, (T, S), 1)
+                q_pos = cache_index[:, None, None] + \
+                    jax.lax.broadcasted_iota(jnp.int32, (T, S), 0)[None]
+                mask = kv_pos[None] <= q_pos  # [B, T, S]
+            else:
+                ck = jax.lax.dynamic_update_slice(
+                    cache["k"], k.astype(cache["k"].dtype),
+                    (0, cache_index, 0, 0)
+                )
+                cv = jax.lax.dynamic_update_slice(
+                    cache["v"], v.astype(cache["v"].dtype),
+                    (0, cache_index, 0, 0)
+                )
+                # causal over global positions: query cache_index+i may
+                # see cache rows 0..cache_index+i (the rest of the
+                # buffer is zeros and masked off)
+                S = ck.shape[1]
+                kv_pos = jax.lax.broadcasted_iota(jnp.int32, (T, S), 1)
+                q_pos = cache_index + jax.lax.broadcasted_iota(
+                    jnp.int32, (T, S), 0
+                )
+                mask = kv_pos <= q_pos  # [T, S]
             new_cache = {"k": ck, "v": cv}
-            # causal over global positions: query cache_index+i may see
-            # cache rows 0..cache_index+i (the rest of the buffer is
-            # zeros and masked off)
-            S = ck.shape[1]
-            kv_pos = jax.lax.broadcasted_iota(jnp.int32, (T, S), 1)
-            q_pos = cache_index + jax.lax.broadcasted_iota(
-                jnp.int32, (T, S), 0
-            )
-            mask = kv_pos <= q_pos  # [T, S]
             out = _grouped_cache_attention(q, ck, cv, mask, rep)
             return dense(
                 features=c.d_model, axis=(-2, -1), name="o_proj"

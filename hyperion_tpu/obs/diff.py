@@ -45,6 +45,13 @@ METRICS: dict[str, str] = {
     # sync vs background-prefetched paths (data/prefetch.py)
     "input_sync_batches_per_s": "higher",
     "input_prefetch_batches_per_s": "higher",
+    # bench.py serving probe (serve/loadgen.py against the continuous-
+    # batching engine): user-facing SLOs regress UP for latencies and
+    # reject rate, DOWN for throughput
+    "serve_tokens_per_s": "higher",
+    "serve_ttft_p50_ms": "lower",
+    "serve_ttft_p99_ms": "lower",
+    "serve_reject_rate": "lower",
 }
 
 
@@ -94,6 +101,15 @@ def normalize(doc: dict) -> dict[str, float]:
                               ("prefetch_batches_per_s",
                                "input_prefetch_batches_per_s")):
                 v = _num(pipe.get(src))
+                if v is not None:
+                    out[name] = v
+        srv = doc.get("serving")
+        if isinstance(srv, dict):
+            for src, name in (("tokens_per_s", "serve_tokens_per_s"),
+                              ("ttft_p50_ms", "serve_ttft_p50_ms"),
+                              ("ttft_p99_ms", "serve_ttft_p99_ms"),
+                              ("reject_rate", "serve_reject_rate")):
+                v = _num(srv.get(src))
                 if v is not None:
                     out[name] = v
     # trainer *_summary.json {"step_ms": ..., "peak_hbm_mb": ...}

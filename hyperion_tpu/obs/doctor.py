@@ -45,8 +45,8 @@ from pathlib import Path
 from hyperion_tpu.obs.heartbeat import heartbeat_age_s, read_heartbeat
 from hyperion_tpu.obs.registry import percentile
 
-_TERMINAL_EVENTS = ("train_end", "generate_done", "publish")
-_STEP_SPANS = ("train_step", "decode_step")
+_TERMINAL_EVENTS = ("train_end", "generate_done", "publish", "serve_end")
+_STEP_SPANS = ("train_step", "decode_step", "serve_tick")
 _FATAL_KINDS = ("nonfinite_loss", "nonfinite_grad")
 
 # stale thresholds: a heartbeat older than STALE_S with no terminal
@@ -173,8 +173,10 @@ def diagnose(
 
     hbm_peak = None
     input_frac = input_wait_s = None
+    serve: dict | None = None
     for s in snapshots:
-        g = s.get("metrics", {}).get("gauges", {})
+        m = s.get("metrics", {})
+        g = m.get("gauges", {})
         p = g.get("hbm_peak_mb")
         if p is not None:
             hbm_peak = p if hbm_peak is None else max(hbm_peak, p)
@@ -184,6 +186,23 @@ def diagnose(
             input_frac = float(g["input_wait_frac"])
         if isinstance(g.get("input_wait_s"), (int, float)):
             input_wait_s = float(g["input_wait_s"])
+        # serving evidence (serve/metrics.py): last snapshot wins here
+        # too — occupancy/queue depth answer "what was it doing at the
+        # end", counters are cumulative anyway
+        c = m.get("counters", {})
+        if "serve_ticks" in c or g.get("queue_depth") is not None:
+            h = m.get("histograms", {})
+            ttft = h.get("ttft_ms") or {}
+            serve = {
+                "completed": c.get("serve_completed"),
+                "rejected": c.get("serve_rejected"),
+                "timed_out": c.get("serve_timed_out"),
+                "queue_depth": g.get("queue_depth"),
+                "slot_occupancy": g.get("slot_occupancy"),
+                "tokens_per_s": g.get("tokens_per_s"),
+                "ttft_p50_ms": ttft.get("p50"),
+                "ttft_p99_ms": ttft.get("p99"),
+            }
 
     # ---- stall signal: tail steps vs the run's own earlier median ----
     stall = None
@@ -319,6 +338,7 @@ def diagnose(
             for e in health
         ],
         "hbm_peak_mb": hbm_peak,
+        "serve": serve,
         "heartbeat": {
             "phase": hb.get("phase"), "step": hb.get("step"),
             "pid": hb.get("pid"), "beats": hb.get("beats"),
@@ -398,6 +418,19 @@ def render_markdown(d: dict) -> str:
                      f"{_fmt(ls['dur_ms'])} ms |")
     if d.get("hbm_peak_mb") is not None:
         lines.append(f"| peak HBM | {_fmt(d['hbm_peak_mb'])} MB |")
+    srv = d.get("serve")
+    if srv:
+        lines.append(
+            f"| serve requests | completed {_fmt(srv['completed'])}, "
+            f"rejected {_fmt(srv['rejected'])}, "
+            f"timed out {_fmt(srv['timed_out'])} |")
+        lines.append(
+            f"| serve saturation | queue depth {_fmt(srv['queue_depth'])}, "
+            f"slot occupancy {_fmt(srv['slot_occupancy'])} |")
+        if srv.get("ttft_p50_ms") is not None:
+            lines.append(
+                f"| TTFT p50 / p99 | {_fmt(srv['ttft_p50_ms'])} / "
+                f"{_fmt(srv['ttft_p99_ms'])} ms |")
     hb = d.get("heartbeat")
     if hb:
         lines.append(

@@ -103,6 +103,7 @@ class Histogram:
             "max": self.max,
             "p50": self.percentile(50),
             "p90": self.percentile(90),
+            "p95": self.percentile(95),
             "p99": self.percentile(99),
         }
 

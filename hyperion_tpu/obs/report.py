@@ -25,7 +25,7 @@ from pathlib import Path
 # the ONE percentile definition, shared with live snapshots
 from hyperion_tpu.obs.registry import percentile as _percentile
 
-_STEP_SPANS = ("train_step", "decode_step")
+_STEP_SPANS = ("train_step", "decode_step", "serve_tick")
 
 
 def read_records(path: str | Path) -> list[dict]:

@@ -1,0 +1,89 @@
+"""Tiny JSONL client for the serve socket — tests and load drivers.
+
+Speaks exactly the `serve/server.py` wire protocol over a local unix
+socket: one JSON object per line out (the request), a stream of JSON
+objects per line back (token events, then a terminal `done` /
+`rejected` / `timed_out` / `error`). No retries, no pooling, no
+discovery — the serving client a test wants, not a production SDK.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Iterator
+
+TERMINAL_EVENTS = ("done", "rejected", "timed_out", "error")
+
+
+class ServeClient:
+    """One connection, requests streamed one at a time.
+
+    with ServeClient("/tmp/hyperion.sock") as c:
+        for ev in c.stream(prompt_ids=[5, 9, 12], max_new_tokens=8):
+            ...
+    """
+
+    def __init__(self, socket_path: str, timeout_s: float = 60.0):
+        self.socket_path = socket_path
+        self.timeout_s = timeout_s
+        self._sock: socket.socket | None = None
+        self._rfile = None
+
+    def connect(self) -> "ServeClient":
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout_s)
+        s.connect(self.socket_path)
+        self._sock = s
+        self._rfile = s.makefile("rb")
+        return self
+
+    def close(self) -> None:
+        if self._rfile is not None:
+            self._rfile.close()
+            self._rfile = None
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def __enter__(self) -> "ServeClient":
+        return self.connect()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------- api
+
+    def stream(self, **request) -> Iterator[dict]:
+        """Send one request, yield its event records through the
+        terminal one. `request` carries the wire fields (prompt /
+        prompt_ids, max_new_tokens, temperature, ...)."""
+        if self._sock is None:
+            raise RuntimeError("client not connected (use `with` or "
+                               ".connect())")
+        line = json.dumps(request, separators=(",", ":")) + "\n"
+        self._sock.sendall(line.encode("utf-8"))
+        want = request.get("id")
+        while True:
+            raw = self._rfile.readline()
+            if not raw:
+                raise ConnectionError("server closed the stream before "
+                                      "a terminal event")
+            rec = json.loads(raw)
+            if want is not None and rec.get("id") not in (want, None):
+                continue  # another request's event on a shared channel
+            yield rec
+            if rec.get("event") in TERMINAL_EVENTS:
+                return
+
+    def generate(self, **request) -> dict:
+        """Blocking convenience: collect the stream, return
+        {"tokens": [...], "final": <terminal record>}."""
+        tokens: list[int] = []
+        final: dict = {}
+        for rec in self.stream(**request):
+            if rec.get("event") == "token" and rec.get("token") is not None:
+                tokens.append(int(rec["token"]))
+            if rec.get("event") in TERMINAL_EVENTS:
+                final = rec
+        return {"tokens": tokens, "final": final}

@@ -1,0 +1,465 @@
+"""Slot-based continuous-batching decode engine — Orca-style iteration
+scheduling on a static-shape TPU cache.
+
+The single-shot path (`infer/generate.py`) decodes ONE batch of aligned
+prompts: prefill, then a `lax.scan` that every request enters and
+leaves together. A server cannot batch that way — requests arrive when
+they arrive, finish when they finish, and a batch that waits for its
+slowest member wastes every other slot's ticks. Continuous batching
+(Yu et al., OSDI '22) decouples the two: the unit of scheduling is one
+decode TICK, and membership of the batch is re-decided between ticks.
+
+TPU constraint that shapes everything here: **recompilation is the
+enemy.** XLA specializes on shapes, so the naive design — re-batch
+active requests into a [n_active, ...] tensor each tick — compiles a
+new executable every time occupancy changes. Instead:
+
+  * The KV cache is a fixed `[S, L]` slab (`S` slots × `L` tokens,
+    `models/llama.py:init_cache` buffers batched over slots). A slot
+    holds one request; a finished slot is refilled from the queue
+    without the shapes ever changing. The decode tick is compiled
+    ONCE, at warmup, forever.
+  * Every per-request quantity the tick needs — cache depth, eos
+    latch, remaining budget, temperature/top_k/top_p, PRNG key — is a
+    `[S]` device array threaded through the jitted call, so slot
+    churn is a cheap scatter into state rows, never a retrace.
+  * Per-slot attention masks key on per-slot lengths: slot b's query
+    at depth `lengths[b]` attends cache rows `0..lengths[b]` of its
+    own row only (the vector-`cache_index` path in
+    `models/llama.py:LlamaAttention`). Inactive slots still compute —
+    static shapes make their lanes free compared to a recompile — and
+    their outputs are discarded on the host.
+  * Prefill for a joining request is a SEPARATE jitted call per
+    prompt-length bucket (next power of two): it runs the prompt
+    through the cached forward at batch 1, scatters the K/V block into
+    the free slot's row, samples the first token (TTFT ends here), and
+    stamps the slot's state row. Buckets make prompt-length variety a
+    handful of warmup compiles instead of one per length.
+
+Semantics contract (the oracle `tests/test_serve.py` pins): at
+temperature 0 a request decoded through this engine — while other
+slots churn arbitrarily — emits **bit-identical tokens** to
+`infer/generate.generate` on the same prompt. Every per-slot op above
+is row-independent, so sharing the batch costs nothing semantically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hyperion_tpu.infer.generate import sample_token_slots
+from hyperion_tpu.serve.metrics import ServeMetrics
+from hyperion_tpu.serve.queue import AdmissionQueue, Request
+
+_SNAPSHOT_EVERY = 32  # ticks between metric snapshots on the stream
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    slots: int = 4                 # S: concurrent requests in flight
+    max_len: int = 0               # L: per-slot cache length (0 = model max)
+    eos_id: int | None = None
+    pad_id: int = 0
+    queue_capacity: int = 64
+    prefill_budget: int = 512      # prompt tokens admitted per round
+    min_bucket: int = 8            # smallest prefill padding bucket
+    snapshot_every: int = _SNAPSHOT_EVERY
+
+
+@dataclasses.dataclass
+class TokenEvent:
+    """One emission the host routes to a transport/test."""
+    request: Request
+    token: int | None              # None for reject/timeout events
+    finished: bool
+    kind: str = "token"            # token | rejected | timed_out
+    reason: str | None = None
+
+
+class Engine:
+    """Continuous-batching engine over one model + one variables tree.
+
+    Host-side it owns the slot table (slot index -> Request), the
+    admission queue, metrics, and telemetry; device-side the [S, L]
+    cache and the [S] state rows. `step()` is one scheduling round
+    (admit -> tick -> route); `run()` loops it."""
+
+    def __init__(
+        self,
+        model: Any,
+        variables: dict,
+        cfg: EngineConfig,
+        *,
+        metrics: ServeMetrics | None = None,
+        tracer=None,
+        heartbeat=None,
+        chaos=None,
+        on_event: Callable[[TokenEvent], Any] | None = None,
+    ):
+        from hyperion_tpu.models.llama import init_cache
+        from hyperion_tpu.obs import heartbeat as hb_mod
+        from hyperion_tpu.obs import trace as trace_mod
+
+        self.model = model
+        self.variables = variables
+        mcfg = model.cfg
+        L = cfg.max_len or mcfg.max_len
+        if L > mcfg.max_len:
+            raise ValueError(
+                f"engine max_len {L} exceeds model max_len {mcfg.max_len}")
+        self.cfg = dataclasses.replace(cfg, max_len=L)
+        self.queue = AdmissionQueue(
+            cfg.queue_capacity, max_total_tokens=L,
+            prefill_budget=cfg.prefill_budget,
+        )
+        self.metrics = metrics or ServeMetrics()
+        self.tracer = tracer if tracer is not None else trace_mod.null_tracer()
+        self.hb = heartbeat if heartbeat is not None \
+            else hb_mod.null_heartbeat()
+        self.chaos = chaos
+        self.on_event = on_event
+        self._slots: list[Request | None] = [None] * cfg.slots
+        self._cache = init_cache(mcfg, cfg.slots, max_len=L)
+        self._state = self._init_state()
+        self._tick_no = 0
+        # donation keeps the [S, L, Hkv, D] slabs in place on real
+        # chips; the CPU backend ignores donation with a warning, so
+        # don't ask there
+        donate = () if jax.default_backend() == "cpu" else (1, 2)
+        self._tick_jit = jax.jit(self._make_tick(), donate_argnums=donate)
+        self._prefill_jit = jax.jit(self._make_prefill(),
+                                    donate_argnums=donate)
+
+    # ------------------------------------------------------ device state
+
+    def _init_state(self) -> dict:
+        S = self.cfg.slots
+        return {
+            "lengths": jnp.zeros((S,), jnp.int32),
+            "active": jnp.zeros((S,), bool),
+            "last_token": jnp.zeros((S,), jnp.int32),
+            "generated": jnp.zeros((S,), jnp.int32),
+            "budget": jnp.ones((S,), jnp.int32),
+            "temperature": jnp.zeros((S,), jnp.float32),
+            "top_k": jnp.zeros((S,), jnp.int32),
+            "top_p": jnp.ones((S,), jnp.float32),
+            "keys": jax.random.split(jax.random.key(0), S),
+        }
+
+    def _make_tick(self):
+        model, eos_id, pad_id = self.model, self.cfg.eos_id, self.cfg.pad_id
+
+        def tick(variables, cache, st):
+            # every slot advances one token: write last_token's K/V at
+            # its own depth, attend its own filled prefix, sample with
+            # its own params. Inactive lanes compute too (static
+            # shapes); their results are masked to pad and never
+            # delivered.
+            logits, cache = model.apply(
+                variables, st["last_token"][:, None],
+                cache=cache, cache_index=st["lengths"],
+            )
+            keys = jax.vmap(jax.random.fold_in)(st["keys"], st["lengths"])
+            nxt = sample_token_slots(
+                logits[:, 0], keys,
+                st["temperature"], st["top_k"], st["top_p"],
+            )
+            nxt = jnp.where(st["active"], nxt, jnp.int32(pad_id))
+            adv = st["active"].astype(jnp.int32)
+            gen = st["generated"] + adv
+            lengths = st["lengths"] + adv
+            hit_eos = (nxt == eos_id) if eos_id is not None \
+                else jnp.zeros_like(st["active"])
+            finished = st["active"] & (hit_eos | (gen >= st["budget"]))
+            st = {
+                **st,
+                "last_token": jnp.where(st["active"], nxt,
+                                        st["last_token"]),
+                "generated": gen,
+                "lengths": lengths,
+                "active": st["active"] & ~finished,
+            }
+            return cache, st, nxt, finished
+
+        return tick
+
+    def _make_prefill(self):
+        from hyperion_tpu.models.llama import init_cache
+
+        model, eos_id = self.model, self.cfg.eos_id
+        mcfg = model.cfg
+
+        def prefill(variables, cache, st, prompt, slot, true_len,
+                    temperature, top_k, top_p, budget, key):
+            # prompt [1, Pb] (bucket-padded; pad K/V beyond true_len is
+            # written but masked until decode overwrites it position by
+            # position). Compiled once per bucket length.
+            Pb = prompt.shape[1]
+            small = init_cache(mcfg, 1, max_len=Pb)
+            logits, small = model.apply(
+                variables, prompt, cache=small, cache_index=0)
+            for layer, filled in zip(cache, small):
+                for kv in ("k", "v"):
+                    layer[kv] = jax.lax.dynamic_update_slice(
+                        layer[kv], filled[kv].astype(layer[kv].dtype),
+                        (slot, 0, 0, 0),
+                    )
+            last = jax.lax.dynamic_slice_in_dim(
+                logits[0], true_len - 1, 1, axis=0)  # [1, V]
+            fkey = jax.random.fold_in(key, true_len - 1)
+            first = sample_token_slots(
+                last, fkey[None], temperature[None], top_k[None],
+                top_p[None],
+            )[0]
+            hit_eos = (first == eos_id) if eos_id is not None else False
+            finished = jnp.logical_or(hit_eos, budget <= 1)
+            st = {
+                "lengths": st["lengths"].at[slot].set(true_len),
+                "active": st["active"].at[slot].set(~finished),
+                "last_token": st["last_token"].at[slot].set(first),
+                "generated": st["generated"].at[slot].set(1),
+                "budget": st["budget"].at[slot].set(budget),
+                "temperature": st["temperature"].at[slot].set(temperature),
+                "top_k": st["top_k"].at[slot].set(top_k),
+                "top_p": st["top_p"].at[slot].set(top_p),
+                "keys": st["keys"].at[slot].set(key),
+            }
+            return cache, st, first, finished
+
+        return prefill
+
+    # --------------------------------------------------------- plumbing
+
+    def bucket(self, prompt_len: int) -> int:
+        """Smallest power-of-two >= prompt_len (floored at min_bucket,
+        capped at max_len): the prefill jit compiles once per value
+        this returns."""
+        b = self.cfg.min_bucket
+        while b < prompt_len:
+            b *= 2
+        return min(b, self.cfg.max_len)
+
+    def compile_stats(self) -> dict:
+        """Executable counts in the two jit caches — the no-recompile
+        guarantee made measurable (tier-1 asserts these stay flat
+        across slot churn after `warmup`)."""
+        return {
+            "tick_executables": self._tick_jit._cache_size(),
+            "prefill_executables": self._prefill_jit._cache_size(),
+        }
+
+    def warmup(self, prompt_lens: list[int] | None = None) -> dict:
+        """Compile the tick and one prefill per bucket up front, then
+        reset serving state. After this, admission/refill/decode never
+        traces again — a request joining mid-flight costs a scatter,
+        not a compile."""
+        lens = sorted({self.bucket(p) for p in (prompt_lens or
+                                                [self.cfg.min_bucket])})
+        with self.tracer.span("serve_warmup") as sp:
+            for pb in lens:
+                dummy = Request(prompt_ids=np.ones((min(pb, 2),), np.int32),
+                                max_new_tokens=2)
+                # pad to the exact bucket so the real compile happens
+                self._prefill_call(dummy, slot=0, bucket_len=pb)
+            _ = self._tick_device()
+            sp.set(buckets=lens)
+        self._state = self._init_state()
+        self._slots = [None] * self.cfg.slots
+        stats = self.compile_stats()
+        self.tracer.event("serve_warmup_done", **stats)
+        return stats
+
+    def _prefill_call(self, req: Request, slot: int,
+                      bucket_len: int | None = None):
+        P = req.prompt_len
+        Pb = bucket_len or self.bucket(P)
+        prompt = np.full((1, Pb), self.cfg.pad_id, np.int32)
+        prompt[0, :P] = req.prompt_ids
+        self._cache, self._state, first, finished = self._prefill_jit(
+            self.variables, self._cache, self._state,
+            jnp.asarray(prompt), jnp.int32(slot), jnp.int32(P),
+            jnp.float32(req.temperature), jnp.int32(req.top_k),
+            jnp.float32(req.top_p), jnp.int32(req.max_new_tokens),
+            jax.random.key(req.seed),
+        )
+        return int(first), bool(finished)
+
+    def _tick_device(self):
+        self._cache, self._state, toks, fins = self._tick_jit(
+            self.variables, self._cache, self._state)
+        # the host fetch is the fence: tick spans time real work
+        return np.asarray(toks), np.asarray(fins)
+
+    # ------------------------------------------------------------ events
+
+    def _emit(self, ev: TokenEvent) -> None:
+        req = ev.request
+        if ev.kind == "token" and ev.token is not None:
+            req.tokens.append(ev.token)
+        if ev.finished or ev.kind != "token":
+            req.finished_at = time.monotonic()
+            if ev.kind == "token":
+                req.status = "done"
+        if self.chaos is not None:
+            self.chaos.on_client(self._tick_no)
+        if req.sink is not None:
+            try:
+                req.sink(ev)
+            except Exception:  # noqa: BLE001
+                # a client that died mid-stream must cost ITS request,
+                # never the engine: drop the sink, let the slot finish
+                # out its budget (eos/budget latch frees it)
+                req.sink = None
+        if self.on_event is not None:
+            self.on_event(ev)
+        if ev.finished or ev.kind != "token":
+            req.done.set()
+
+    # -------------------------------------------------------- public api
+
+    def submit(self, req: Request) -> tuple[bool, str | None]:
+        """Queue a request (thread-safe). Rejections emit immediately —
+        backpressure the caller can act on, not a silent drop."""
+        ok, reason = self.queue.submit(req)
+        if ok:
+            self.metrics.on_accept()
+        else:
+            self.metrics.on_reject(reason)
+            self.tracer.event("request_rejected", request=req.id,
+                              reason=reason, prompt_len=req.prompt_len)
+            self._emit(TokenEvent(req, None, True, kind="rejected",
+                                  reason=reason))
+        return ok, reason
+
+    @property
+    def n_active(self) -> int:
+        return sum(1 for r in self._slots if r is not None)
+
+    @property
+    def idle(self) -> bool:
+        return self.n_active == 0 and len(self.queue) == 0
+
+    def step(self) -> list[TokenEvent]:
+        """One scheduling round: admit from the queue into free slots
+        (prefill, budget-limited), advance all active slots one token,
+        route emissions. Returns this round's emissions."""
+        emissions: list[TokenEvent] = []
+        now = time.monotonic()
+
+        free = [s for s, r in enumerate(self._slots) if r is None]
+        if free:
+            admit, expired = self.queue.pop_ready(len(free), now)
+        else:
+            admit, expired = [], self.queue.drop_expired(now)
+        for req in expired:
+            self.metrics.on_timeout()
+            self.tracer.event("request_timeout", request=req.id,
+                              waited_s=round(now - req.submitted_at, 3))
+            ev = TokenEvent(req, None, True, kind="timed_out",
+                            reason="deadline exceeded in queue")
+            self._emit(ev)
+            emissions.append(ev)
+        for req in admit:
+            slot = free.pop(0)
+            with self.tracer.span("serve_prefill", step=self._tick_no) as sp:
+                first, finished = self._prefill_call(req, slot)
+                sp.set(request=req.id, slot=slot,
+                       prompt_len=req.prompt_len,
+                       bucket=self.bucket(req.prompt_len))
+            req.prefilled_at = req.first_token_at = time.monotonic()
+            req._last_emit_at = req.first_token_at
+            self.metrics.on_first_token(req, req.first_token_at)
+            self.metrics.count_tokens(1)  # the prefill-sampled token
+            ev = TokenEvent(req, first, finished)
+            self._emit(ev)
+            emissions.append(ev)
+            if finished:
+                self.metrics.on_finish(req)
+            else:
+                self._slots[slot] = req
+
+        if self.n_active:
+            if self.chaos is not None:
+                self.chaos.on_tick(self._tick_no)
+            with self.tracer.span("serve_tick", step=self._tick_no) as sp:
+                t0 = time.monotonic()
+                toks, fins = self._tick_device()
+                dur = time.monotonic() - t0
+                sp.set(active=self.n_active)
+            emitted = 0
+            tnow = time.monotonic()
+            for s, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                ev = TokenEvent(req, int(toks[s]), bool(fins[s]))
+                gap_from = getattr(req, "_last_emit_at", None)
+                if gap_from is not None:
+                    self.metrics.on_token_gap(tnow - gap_from)
+                req._last_emit_at = tnow
+                self._emit(ev)
+                emissions.append(ev)
+                emitted += 1
+                if ev.finished:
+                    self.metrics.on_finish(req, tnow)
+                    self._slots[s] = None
+            self.metrics.on_tick(dur, emitted)
+            self._tick_no += 1
+            if self.cfg.snapshot_every \
+                    and self._tick_no % self.cfg.snapshot_every == 0:
+                self.tracer.snapshot(self.metrics.reg, step=self._tick_no)
+
+        self.metrics.observe_state(
+            len(self.queue), self.n_active, self.cfg.slots)
+        self.hb.beat(step=self._tick_no, phase="serve",
+                     active=self.n_active, queue=len(self.queue))
+        return emissions
+
+    def run(
+        self,
+        *,
+        should_stop: Callable[[], bool] | None = None,
+        drain_when: Callable[[], bool] | None = None,
+        idle_sleep_s: float = 0.01,
+    ) -> dict:
+        """Serve until `should_stop()` (hard stop) or until
+        `drain_when()` and the engine is idle (graceful drain; default:
+        drain immediately once idle). Emits `serve_start`/`serve_end`
+        lifecycle events — `obs doctor` reads `serve_end` as the
+        terminal record separating a drained server from a hung one."""
+        drain_when = drain_when or (lambda: True)
+        self.tracer.event("serve_start", slots=self.cfg.slots,
+                          max_len=self.cfg.max_len)
+        self.hb.pulse(phase="serve", step=self._tick_no)
+        try:
+            while True:
+                if should_stop is not None and should_stop():
+                    break
+                if self.idle:
+                    # drain_when first, idle RE-checked after: a
+                    # transport's last submit happens-before its EOF
+                    # flag, so this ordering can never strand a request
+                    # that raced the drain signal
+                    if drain_when() and self.idle:
+                        break
+                    self.hb.beat(step=self._tick_no, phase="serve_idle")
+                    time.sleep(idle_sleep_s)
+                    continue
+                self.step()
+        finally:
+            summary = self.metrics.summary()
+            self.tracer.snapshot(self.metrics.reg, step=self._tick_no)
+            self.tracer.event(
+                "serve_end", ticks=self._tick_no,
+                completed=summary["completed"],
+                rejected=summary["rejected"],
+                timed_out=summary["timed_out"],
+                tokens=summary["tokens"],
+            )
+            self.hb.close(phase="done", tokens=summary["tokens"])
+        return summary

@@ -1,0 +1,114 @@
+"""Synthetic load generator — Poisson arrivals against the engine.
+
+Serving numbers measured one request at a time are fiction: TTFT under
+load includes queue wait, throughput under load includes slot
+contention, and reject rate only exists when arrivals outpace drains.
+This driver produces those conditions deterministically (seeded
+arrival schedule, seeded prompt mix) and runs CLOSED-LOOP with the
+engine: the driver and the serve loop share one thread, alternating
+submit-due-requests with `engine.step()`, so a run is reproducible —
+no wall-clock race decides which tick a request joins.
+
+Used by `bench.py --child-serving` (the `serving` probe riding the
+headline line) and the slow soak test; both report the same keys, so
+`obs diff` tracks serving regressions exactly like the PR-4
+`input_pipeline` probe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from hyperion_tpu.obs.registry import percentile
+from hyperion_tpu.serve.queue import Request
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadSpec:
+    n_requests: int = 32
+    rate_hz: float = 50.0             # Poisson arrival rate
+    prompt_lens: tuple[int, ...] = (4, 8, 16, 24)   # mixed, sampled
+    max_new: tuple[int, ...] = (4, 8, 16)
+    vocab: int = 256
+    temperature: float = 0.0
+    seed: int = 0
+    deadline_s: float | None = None
+
+
+def run_load(engine, spec: LoadSpec) -> dict:
+    """Drive one load run to drain; return the serving report.
+
+    Arrivals follow exponential inter-arrival times (a Poisson
+    process) pre-drawn from `spec.seed`; prompt contents/lengths and
+    decode budgets come from the same rng. Between engine steps the
+    driver submits every request whose arrival time has passed —
+    closed-loop, so a slow engine sees a burstier queue, exactly like
+    a real ingress under fixed offered load."""
+    rng = np.random.default_rng(spec.seed)
+    inter = rng.exponential(1.0 / spec.rate_hz, spec.n_requests)
+    arrivals = np.cumsum(inter)
+    reqs = [
+        Request(
+            prompt_ids=rng.integers(
+                1, spec.vocab, rng.choice(spec.prompt_lens)),
+            max_new_tokens=int(rng.choice(spec.max_new)),
+            temperature=spec.temperature,
+            seed=int(rng.integers(0, 2**31 - 1)),
+            deadline_s=spec.deadline_s,
+            id=f"load_{i}",
+        )
+        for i in range(spec.n_requests)
+    ]
+
+    t0 = time.monotonic()
+    submitted = 0
+    rejected = 0
+    while submitted < spec.n_requests or not engine.idle:
+        now = time.monotonic() - t0
+        while submitted < spec.n_requests and arrivals[submitted] <= now:
+            ok, _reason = engine.submit(reqs[submitted])
+            rejected += 0 if ok else 1
+            submitted += 1
+        if engine.idle:
+            if submitted >= spec.n_requests:
+                break  # tail request door-rejected with nothing in flight
+            # nothing in flight: sleep to the next arrival instead of
+            # spinning the scheduler
+            nxt = arrivals[submitted] - (time.monotonic() - t0)
+            if nxt > 0:
+                time.sleep(min(nxt, 0.05))
+            continue
+        engine.step()
+    elapsed = time.monotonic() - t0
+
+    done = [r for r in reqs if r.status == "done"]
+    timed_out = sum(1 for r in reqs if r.status == "timed_out")
+    ttft_ms = [
+        (r.first_token_at - r.submitted_at) * 1e3
+        for r in done if r.first_token_at is not None
+    ]
+    e2e_ms = [
+        (r.finished_at - r.submitted_at) * 1e3
+        for r in done if r.finished_at is not None
+    ]
+    tokens = sum(len(r.tokens) for r in done)
+    return {
+        "requests": spec.n_requests,
+        "completed": len(done),
+        "rejected": rejected,
+        "timed_out": timed_out,
+        "reject_rate": round(rejected / spec.n_requests, 4)
+        if spec.n_requests else 0.0,
+        "tokens": tokens,
+        "tokens_per_s": round(tokens / elapsed, 2) if elapsed > 0 else 0.0,
+        "ttft_p50_ms": round(percentile(ttft_ms, 50), 3) if ttft_ms else None,
+        "ttft_p99_ms": round(percentile(ttft_ms, 99), 3) if ttft_ms else None,
+        "e2e_p50_ms": round(percentile(e2e_ms, 50), 3) if e2e_ms else None,
+        "e2e_p99_ms": round(percentile(e2e_ms, 99), 3) if e2e_ms else None,
+        "elapsed_s": round(elapsed, 3),
+        "arrival_rate_hz": spec.rate_hz,
+        "slots": engine.cfg.slots,
+    }

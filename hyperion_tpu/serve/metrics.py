@@ -1,0 +1,126 @@
+"""Serving gauges on the PR-1 obs registry — SLO numbers, not step numbers.
+
+Training telemetry asks "how fast is the loop"; serving telemetry asks
+"what does a user experience". The four canonical serving signals:
+
+  * **TTFT** (time to first token) — submission → first emitted token,
+    queue wait + prefill included. The interactive-feel number.
+  * **TPOT** (time per output token) — inter-token gap during decode.
+    The streaming-smoothness number.
+  * **e2e latency** — submission → final token, p50/p95/p99.
+  * **throughput + saturation** — aggregate tokens/sec, queue depth,
+    slot occupancy, rejected/timed-out counts.
+
+Everything lands in one `MetricsRegistry` (histograms carry
+p50/p90/p95/p99 in every snapshot) and streams through the same tracer
+records trainers use, so `obs summarize`, `obs doctor`, and `obs diff`
+read serve runs with zero new parsers. The `tokens_per_s` gauge is
+deliberately the SAME key the trainers publish: a serve run's
+throughput rides every existing reader.
+"""
+
+from __future__ import annotations
+
+import time
+
+from hyperion_tpu.obs.registry import MetricsRegistry
+
+
+class ServeMetrics:
+    """Serving instruments over one registry; the engine is the only
+    writer, any tracer snapshot is the reader."""
+
+    def __init__(self, registry: MetricsRegistry | None = None,
+                 clock=time.monotonic):
+        self.reg = registry or MetricsRegistry()
+        self._clock = clock
+        self._t0 = clock()
+        self._tokens = 0
+        # pre-create the lifecycle counters: a drained run that never
+        # rejected anything should snapshot rejected=0, not omit the
+        # key (absent evidence reads as "unknown" downstream)
+        for name in ("serve_accepted", "serve_rejected",
+                     "serve_timed_out", "serve_completed", "serve_ticks"):
+            self.reg.counter(name)
+
+    # -------------------------------------------------- admission edge
+
+    def on_accept(self) -> None:
+        self.reg.counter("serve_accepted").inc()
+
+    def on_reject(self, reason: str) -> None:
+        self.reg.counter("serve_rejected").inc()
+        self.reg.counter(f"serve_rejected_{reason}").inc()
+
+    def on_timeout(self) -> None:
+        self.reg.counter("serve_timed_out").inc()
+
+    # ------------------------------------------------- per-request SLOs
+
+    def on_first_token(self, req, now: float | None = None) -> None:
+        now = self._clock() if now is None else now
+        self.reg.histogram("ttft_ms").observe(
+            (now - req.submitted_at) * 1e3)
+
+    def on_token_gap(self, gap_s: float) -> None:
+        self.reg.histogram("tpot_ms").observe(gap_s * 1e3)
+
+    def on_finish(self, req, now: float | None = None) -> None:
+        now = self._clock() if now is None else now
+        self.reg.counter("serve_completed").inc()
+        self.reg.histogram("e2e_ms").observe(
+            (now - req.submitted_at) * 1e3)
+
+    # ------------------------------------------------------- loop state
+
+    def count_tokens(self, n: int) -> None:
+        """Delivered-token accounting — tick emissions AND the
+        prefill-sampled first token of each request (TTFT's token)
+        both flow through here, so tokens_per_s matches what clients
+        actually received."""
+        if n:
+            self._tokens += n
+            self.reg.counter("tokens").inc(n)
+
+    def on_tick(self, dur_s: float, tokens_emitted: int) -> None:
+        self.reg.counter("serve_ticks").inc()
+        self.reg.histogram("serve_tick_ms").observe(dur_s * 1e3)
+        self.count_tokens(tokens_emitted)
+
+    def observe_state(self, queue_depth: int, slots_active: int,
+                      n_slots: int) -> None:
+        """Saturation gauges, refreshed every tick (cheap: three host
+        floats). Occupancy near 1.0 with queue depth growing = scale
+        out; occupancy low with rejections = prompt lengths exceed the
+        cache, not capacity."""
+        self.reg.gauge("queue_depth").set(queue_depth)
+        self.reg.gauge("slots_active").set(slots_active)
+        self.reg.gauge("slot_occupancy").set(
+            slots_active / n_slots if n_slots else 0.0)
+        elapsed = self._clock() - self._t0
+        if elapsed > 0:
+            # same key the trainers publish: every obs reader already
+            # knows what tokens_per_s means
+            self.reg.gauge("tokens_per_s").set(self._tokens / elapsed)
+
+    # ---------------------------------------------------------- summary
+
+    def summary(self) -> dict:
+        """Host-side roll-up for the drain report / load generator."""
+        snap = self.reg.snapshot()
+        c, g, h = snap["counters"], snap["gauges"], snap["histograms"]
+        total = (c.get("serve_accepted", 0) + c.get("serve_rejected", 0))
+        return {
+            "accepted": int(c.get("serve_accepted", 0)),
+            "rejected": int(c.get("serve_rejected", 0)),
+            "timed_out": int(c.get("serve_timed_out", 0)),
+            "completed": int(c.get("serve_completed", 0)),
+            "reject_rate": (c.get("serve_rejected", 0) / total
+                            if total else 0.0),
+            "tokens": int(c.get("tokens", 0)),
+            "tokens_per_s": g.get("tokens_per_s"),
+            "ttft_ms": h.get("ttft_ms", {"count": 0}),
+            "tpot_ms": h.get("tpot_ms", {"count": 0}),
+            "e2e_ms": h.get("e2e_ms", {"count": 0}),
+            "ticks": int(c.get("serve_ticks", 0)),
+        }

@@ -1,0 +1,201 @@
+"""Bounded admission queue with backpressure, deadlines, and a
+prefill-token budget per scheduling round.
+
+Serving dies two ways at the front door: unbounded queues (every
+request accepted, every request slow — the collapse mode) and prefill
+monopolies (one 4k-token prompt prefilling while eight interactive
+requests' decode ticks wait). Both are queue policy, not engine policy,
+so they live here:
+
+  * **Backpressure** — `submit` REJECTS with a machine-readable reason
+    (`queue_full`, `too_long`) instead of buffering forever; the
+    caller/client sees the rejection immediately and can retry
+    elsewhere. Rejecting at admission is the only point where the cost
+    of saying no is still zero.
+  * **Deadlines** — a request may carry an SLO (`deadline_s`, relative
+    to submission). The scheduler drops expired requests at pop time
+    (`timed_out`) rather than burning slots decoding answers nobody is
+    waiting for.
+  * **FIFO with a prefill budget** — `pop_ready` admits in arrival
+    order but caps the total prompt tokens admitted per scheduling
+    round. Prefill is the only O(prompt) step in the serve loop; the
+    budget bounds how long any single round can stall the decode ticks
+    of requests already in flight. A prompt larger than the whole
+    budget still admits when it reaches the head (alone in its round) —
+    bounded delay, never starvation.
+
+The queue is thread-safe: transports (stdin reader thread, socket
+handler threads) submit concurrently while the engine loop pops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+import numpy as np
+
+_ids = itertools.count()
+
+# machine-readable rejection reasons (the wire contract; tests and the
+# metrics counters key on these strings)
+REJECT_QUEUE_FULL = "queue_full"
+REJECT_TOO_LONG = "too_long"
+REJECT_BAD_REQUEST = "bad_request"
+TIMED_OUT = "timed_out"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request plus its serving bookkeeping.
+
+    `prompt_ids` is a dense int32 vector (no padding). Timestamps are
+    host-monotonic; the metrics layer derives TTFT/TPOT/e2e from them.
+    `sink` is set by the transport that owns the reply channel (None
+    for in-process callers, which read `tokens` / wait on `done`)."""
+
+    prompt_ids: np.ndarray
+    max_new_tokens: int
+    id: str = ""
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    deadline_s: float | None = None      # SLO relative to submission
+    sink: Callable[[dict], Any] | None = None
+
+    # --- runtime state (engine-owned) ---
+    submitted_at: float = 0.0
+    prefilled_at: float | None = None
+    first_token_at: float | None = None
+    finished_at: float | None = None
+    tokens: list[int] = dataclasses.field(default_factory=list)
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    status: str = "queued"  # queued|active|done|rejected|timed_out
+
+    def __post_init__(self):
+        self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
+        if not self.id:
+            self.id = f"req_{next(_ids)}"
+        if not self.submitted_at:
+            self.submitted_at = time.monotonic()
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt_ids.shape[0])
+
+    @property
+    def deadline_at(self) -> float | None:
+        if self.deadline_s is None:
+            return None
+        return self.submitted_at + self.deadline_s
+
+
+class AdmissionQueue:
+    """Bounded FIFO with reject-with-reason and prefill-budget pops."""
+
+    def __init__(
+        self,
+        capacity: int = 64,
+        *,
+        max_total_tokens: int,
+        prefill_budget: int = 512,
+    ):
+        """`max_total_tokens` = the engine's per-slot cache length: a
+        request whose prompt + max_new_tokens cannot fit is rejected at
+        the door (it could never complete). `prefill_budget` caps the
+        prompt tokens admitted per `pop_ready` round."""
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.max_total_tokens = max_total_tokens
+        self.prefill_budget = max(1, prefill_budget)
+        self._q: deque[Request] = deque()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ admit
+
+    def submit(self, req: Request) -> tuple[bool, str | None]:
+        """(accepted, reject_reason). Rejection is immediate and final —
+        the caller owns retry policy, the queue never buffers beyond
+        `capacity`."""
+        if req.max_new_tokens < 1:
+            req.status = "rejected"
+            return False, REJECT_BAD_REQUEST
+        if req.prompt_len < 1:
+            req.status = "rejected"
+            return False, REJECT_BAD_REQUEST
+        if req.prompt_len + req.max_new_tokens > self.max_total_tokens:
+            req.status = "rejected"
+            return False, REJECT_TOO_LONG
+        with self._lock:
+            if len(self._q) >= self.capacity:
+                req.status = "rejected"
+                return False, REJECT_QUEUE_FULL
+            self._q.append(req)
+        return True, None
+
+    # ------------------------------------------------------------- pops
+
+    def pop_ready(
+        self, n_slots: int, now: float | None = None
+    ) -> tuple[list[Request], list[Request]]:
+        """(admit, timed_out) for one scheduling round.
+
+        FIFO order, at most `n_slots` requests, at most
+        `prefill_budget` total prompt tokens — except that a head
+        request whose prompt alone exceeds the budget is admitted when
+        nothing else has been this round (otherwise it would starve
+        forever). Expired requests are dropped here, at the last moment
+        before their prefill would be paid."""
+        now = time.monotonic() if now is None else now
+        admit: list[Request] = []
+        expired: list[Request] = []
+        budget = self.prefill_budget
+        with self._lock:
+            while self._q and len(admit) < n_slots:
+                head = self._q[0]
+                dl = head.deadline_at
+                if dl is not None and now > dl:
+                    self._q.popleft()
+                    head.status = TIMED_OUT
+                    expired.append(head)
+                    continue
+                if head.prompt_len > budget and admit:
+                    break  # next round gets a fresh budget for it
+                self._q.popleft()
+                head.status = "active"
+                admit.append(head)
+                budget -= head.prompt_len
+                if budget <= 0:
+                    break
+        return admit, expired
+
+    def drop_expired(self, now: float | None = None) -> list[Request]:
+        """Sweep expired requests without admitting (used while all
+        slots are busy so waiting requests still time out on time)."""
+        now = time.monotonic() if now is None else now
+        expired: list[Request] = []
+        with self._lock:
+            alive: deque[Request] = deque()
+            for r in self._q:
+                dl = r.deadline_at
+                if dl is not None and now > dl:
+                    r.status = TIMED_OUT
+                    expired.append(r)
+                else:
+                    alive.append(r)
+            self._q = alive
+        return expired
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    @property
+    def depth(self) -> int:
+        return len(self)

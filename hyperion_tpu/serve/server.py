@@ -1,0 +1,342 @@
+"""JSONL serving front-end — `hyperion serve --ckpt ...`.
+
+Two transports over one wire protocol, one JSON object per line:
+
+  * **stdin/stdout** (default): requests read from stdin, token events
+    streamed to stdout, clean drain on EOF. Pipes compose — the smoke
+    script (`scripts/serve_smoke.sh`) and any shell harness drive the
+    full engine without sockets.
+  * **local unix socket** (`--socket PATH`): a threaded acceptor;
+    each connection submits requests and receives exactly its own
+    requests' events back (`serve/client.py` is the matching client).
+    Local-only by design: this repo's zero-egress rule means the
+    network story stops at the socket file.
+
+Request line:
+    {"id": "r1", "prompt": "text", "max_new_tokens": 32,
+     "temperature": 0.0, "top_k": 0, "top_p": 1.0, "seed": 0,
+     "deadline_s": 5.0}
+`prompt_ids` (a raw int list) substitutes for `prompt` when no
+tokenizer is loaded. Every response line carries the request id:
+    {"id": "r1", "event": "token", "token": 17, "text": "..."}
+    {"id": "r1", "event": "done", "n_tokens": 32, "text": "..."}
+    {"id": "r1", "event": "rejected"|"timed_out", "reason": "..."}
+    {"id": null, "event": "error", "error": "..."}   (unparseable line)
+
+The engine loop always runs on the main thread; transports only
+submit into the admission queue (thread-safe) and own their reply
+channels via per-request sinks. Telemetry rides the same opt-in
+HYPERION_TELEMETRY stream as every other entry point, with `serve`
+phase heartbeats so `obs doctor` can tell a hung server from a
+drained one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+
+def event_record(ev, tok=None) -> dict:
+    """TokenEvent -> one wire record."""
+    req = ev.request
+    if ev.kind != "token":
+        return {"id": req.id, "event": ev.kind, "reason": ev.reason}
+    rec: dict = {"id": req.id, "event": "token", "token": ev.token}
+    if tok is not None and ev.token is not None:
+        try:
+            rec["text"] = tok.decode([ev.token])
+        except Exception:  # noqa: BLE001 — a weird id must not kill the stream
+            pass
+    if ev.finished:
+        done: dict = {"id": req.id, "event": "done",
+                      "n_tokens": len(req.tokens)}
+        if tok is not None:
+            eos = getattr(tok, "eos_id", None)
+            done["text"] = tok.decode(
+                [t for t in req.tokens if t != eos])
+        rec = [rec, done]  # token line, then the terminal line
+    return rec
+
+
+def parse_request_line(line: str, tok=None, defaults: dict | None = None):
+    """One wire line -> Request, or an error record. Unknown keys are
+    ignored (forward compatibility beats strictness on a line
+    protocol)."""
+    from hyperion_tpu.serve.queue import Request
+
+    defaults = defaults or {}
+    try:
+        doc = json.loads(line)
+    except json.JSONDecodeError as e:
+        return {"id": None, "event": "error", "error": f"bad json: {e}"}
+    if not isinstance(doc, dict):
+        return {"id": None, "event": "error",
+                "error": "request line must be a JSON object"}
+    if "prompt_ids" in doc:
+        ids = doc["prompt_ids"]
+    elif "prompt" in doc:
+        if tok is None:
+            return {"id": doc.get("id"), "event": "error",
+                    "error": "text prompt needs a tokenizer "
+                             "(--tokenizer-dir); send prompt_ids"}
+        ids = tok.encode(str(doc["prompt"]))
+    else:
+        return {"id": doc.get("id"), "event": "error",
+                "error": "request needs 'prompt' or 'prompt_ids'"}
+    try:
+        return Request(
+            prompt_ids=ids,
+            id=str(doc.get("id", "")),
+            max_new_tokens=int(doc.get("max_new_tokens",
+                                       defaults.get("max_new_tokens", 32))),
+            temperature=float(doc.get("temperature", 0.0)),
+            top_k=int(doc.get("top_k", 0)),
+            top_p=float(doc.get("top_p", 1.0)),
+            seed=int(doc.get("seed", 0)),
+            deadline_s=(float(doc["deadline_s"])
+                        if doc.get("deadline_s") is not None else None),
+        )
+    except (TypeError, ValueError) as e:
+        return {"id": doc.get("id"), "event": "error",
+                "error": f"bad request field: {e}"}
+
+
+class _LineWriter:
+    """Locked JSONL writer — transports interleave whole lines, never
+    partial ones. Accepts text or binary files (socket wfile is
+    binary)."""
+
+    def __init__(self, f):
+        self._f = f
+        self._lock = threading.Lock()
+
+    def write(self, rec) -> None:
+        recs = rec if isinstance(rec, list) else [rec]
+        with self._lock:
+            for r in recs:
+                line = json.dumps(r, separators=(",", ":")) + "\n"
+                try:
+                    self._f.write(line)
+                except TypeError:
+                    self._f.write(line.encode("utf-8"))
+            self._f.flush()
+
+
+def serve_jsonl(engine, infile, outfile, tok=None,
+                defaults: dict | None = None) -> dict:
+    """stdin/stdout (or any file-pair) mode: a reader thread feeds the
+    queue; the engine loop drains on EOF. Returns the engine summary."""
+    out = _LineWriter(outfile)
+    eof = threading.Event()
+
+    def sink(ev):
+        out.write(event_record(ev, tok))
+
+    def reader():
+        try:
+            for line in infile:
+                line = line.strip()
+                if not line:
+                    continue
+                parsed = parse_request_line(line, tok, defaults)
+                if isinstance(parsed, dict):  # error record
+                    out.write(parsed)
+                    continue
+                parsed.sink = sink
+                engine.submit(parsed)
+        finally:
+            eof.set()
+
+    t = threading.Thread(target=reader, name="serve-stdin", daemon=True)
+    t.start()
+    summary = engine.run(drain_when=eof.is_set)
+    t.join(timeout=5)
+    return summary
+
+
+def serve_socket(engine, socket_path: str, tok=None,
+                 defaults: dict | None = None,
+                 should_stop=None, ready=None) -> dict:
+    """Unix-socket mode: threaded acceptor submits, engine loop (this
+    thread) decodes. Each connection gets exactly its own requests'
+    events. `ready` (an optional threading.Event) is set once the
+    socket is listening — tests wait on it instead of polling."""
+    import os
+    import socketserver
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            writer = _LineWriter(self.wfile)
+            pending: list = []
+            for raw in self.rfile:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line:
+                    continue
+                parsed = parse_request_line(line, tok, defaults)
+                if isinstance(parsed, dict):
+                    writer.write(parsed)
+                    continue
+                parsed.sink = lambda ev: writer.write(event_record(ev, tok))
+                pending.append(parsed)
+                engine.submit(parsed)
+            for req in pending:  # connection half-closed: finish streams
+                req.done.wait(timeout=600)
+
+    class Server(socketserver.ThreadingMixIn,
+                 socketserver.UnixStreamServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    try:
+        os.unlink(socket_path)
+    except OSError:
+        pass
+    srv = Server(socket_path, Handler)
+    acceptor = threading.Thread(target=srv.serve_forever,
+                                name="serve-accept", daemon=True)
+    acceptor.start()
+    if ready is not None:
+        ready.set()
+    try:
+        summary = engine.run(
+            should_stop=should_stop,
+            # a socket server idles between connections; only an
+            # explicit stop drains it
+            drain_when=(should_stop or (lambda: False)),
+        )
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        try:
+            os.unlink(socket_path)
+        except OSError:
+            pass
+    return summary
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hyperion serve",
+        description="continuous-batching inference server over a "
+                    "gathered Llama export (stdin/JSONL by default, "
+                    "--socket for a local unix socket)",
+    )
+    p.add_argument("--ckpt", required=True,
+                   help="gathered-export .npz (written by the trainers)")
+    p.add_argument("--tokenizer-dir", default="data/tokenizer")
+    p.add_argument("--no-tokenizer", action="store_true",
+                   help="serve raw prompt_ids only (no text encode/"
+                        "decode; eos disabled unless --eos-id)")
+    p.add_argument("--max-len", type=int, default=256,
+                   help="per-slot KV-cache length: prompt + "
+                        "max_new_tokens must fit (also the admission "
+                        "bound)")
+    p.add_argument("--slots", type=int, default=4,
+                   help="concurrent requests decoded per tick (the "
+                        "static batch dimension)")
+    p.add_argument("--queue-capacity", type=int, default=64,
+                   help="admission queue bound; beyond it requests are "
+                        "rejected with reason queue_full")
+    p.add_argument("--prefill-budget", type=int, default=512,
+                   help="prompt tokens admitted per scheduling round — "
+                        "caps how long one giant prompt can stall "
+                        "in-flight decode ticks")
+    p.add_argument("--max-new-default", type=int, default=32,
+                   help="max_new_tokens when a request omits it")
+    p.add_argument("--eos-id", type=int, default=None,
+                   help="override the eos token id (default: the "
+                        "tokenizer's)")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="listen on a local unix socket instead of "
+                        "stdin/stdout")
+    p.add_argument("--warmup-lens", default="8,32",
+                   help="comma-separated prompt lengths to pre-compile "
+                        "prefill buckets for (the tick always warms)")
+    p.add_argument("--heartbeat-every", type=int, default=25,
+                   help="serve-phase heartbeat cadence in ticks (see "
+                        "`obs doctor`)")
+    p.add_argument("--chaos", default="",
+                   help="deterministic fault plan (testing/chaos.py): "
+                        "stall@tick=N:SECS, slow_client@tick=N:SECS, "
+                        "kill@tick=N, ... — serve-loop drills")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from hyperion_tpu.checkpoint.io import load_gathered
+    from hyperion_tpu.infer.generate import model_from_npz
+    from hyperion_tpu.obs import heartbeat as obs_heartbeat
+    from hyperion_tpu.obs import trace as obs_trace
+    from hyperion_tpu.serve.engine import Engine, EngineConfig
+
+    tok = None
+    if not args.no_tokenizer:
+        from hyperion_tpu.data.bpe import ByteBPE
+
+        tok = ByteBPE.load(args.tokenizer_dir)
+
+    tracer = obs_trace.from_env(
+        "data/telemetry.jsonl", run=f"serve_{int(time.time())}")
+    hb = obs_heartbeat.Heartbeat.for_tracer(tracer,
+                                            every=args.heartbeat_every)
+    hb.pulse(phase="load")
+    chaos = None
+    if args.chaos:
+        from hyperion_tpu.testing import chaos as chaos_mod
+
+        chaos = chaos_mod.activate(args.chaos)
+
+    with tracer.span("load") as ld:
+        params = load_gathered(args.ckpt)
+        model, cached = model_from_npz(params, args.max_len)
+        ld.set(ckpt=args.ckpt, cached=cached)
+    if not cached:
+        print("hyperion serve needs a Llama (KV-cache) export — "
+              "TransformerLM/MoE recompute decode has no slot cache "
+              "to batch over", file=sys.stderr)
+        tracer.close()
+        return 2
+
+    eos_id = args.eos_id
+    if eos_id is None and tok is not None:
+        eos_id = tok.eos_id
+    engine = Engine(
+        model, {"params": params},
+        EngineConfig(
+            slots=args.slots, max_len=args.max_len, eos_id=eos_id,
+            queue_capacity=args.queue_capacity,
+            prefill_budget=args.prefill_budget,
+        ),
+        tracer=tracer, heartbeat=hb, chaos=chaos,
+    )
+    hb.pulse(phase="warmup")
+    warm = [int(x) for x in args.warmup_lens.split(",") if x.strip()]
+    engine.warmup(warm or None)
+
+    defaults = {"max_new_tokens": args.max_new_default}
+    try:
+        if args.socket:
+            print(f"[serve] listening on {args.socket} "
+                  f"({args.slots} slots, max_len {args.max_len})",
+                  file=sys.stderr)
+            serve_socket(engine, args.socket, tok, defaults)
+        else:
+            serve_jsonl(engine, sys.stdin, sys.stdout, tok, defaults)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        tracer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,6 +15,17 @@ or `HYPERION_CHAOS`:
     nan_loss@step=N      poison the HealthMonitor's loss scalar at step
                          N (divergence without waiting for real NaNs)
     stall@step=N:SECS    sleep SECS before step N (stall/hang shapes)
+    kill@tick=N          the same kill/sigterm/stall family scoped to
+    sigterm@tick=N       the SERVE loop's decode ticks (serve/engine.py
+    stall@tick=N:SECS    calls `on_tick` before tick N) — a stalled
+                         engine stops beating, which is exactly what
+                         `obs doctor` must flag as hung
+    slow_client@tick=N:SECS
+                         sleep SECS inside the engine's token-delivery
+                         path at tick N — a consumer that stops
+                         draining (dead socket, wedged pipe) and
+                         backpressures the serve loop from the client
+                         side rather than the device side
     corrupt_ckpt@latest  at activation, corrupt the newest existing
                          checkpoint (truncate its largest payload file)
                          — the partial-save artifact restore must skip
@@ -54,27 +65,30 @@ from hyperion_tpu.utils import retry as retry_mod
 ENV_VAR = "HYPERION_CHAOS"
 
 _STEP_CLAUSE = re.compile(r"^(kill|sigterm|nan_loss|stall)@step=(\d+)(?::([0-9.]+))?$")
+_TICK_CLAUSE = re.compile(
+    r"^(kill|sigterm|stall|slow_client)@tick=(\d+)(?::([0-9.]+))?$")
 _CKPT_CLAUSE = re.compile(r"^corrupt_ckpt@latest$")
 _IO_CLAUSE = re.compile(r"^io_fail@p=([0-9.]+)$")
 
 
 @dataclasses.dataclass(frozen=True)
 class Fault:
-    kind: str                 # kill | sigterm | nan_loss | stall | corrupt_ckpt | io_fail
-    step: int | None = None
-    secs: float = 0.0         # stall duration
+    kind: str                 # kill | sigterm | nan_loss | stall | slow_client | corrupt_ckpt | io_fail
+    step: int | None = None   # trainer step OR serve tick, per `unit`
+    secs: float = 0.0         # stall / slow_client duration
     p: float = 0.0            # io_fail probability
+    unit: str = "step"        # "step" (trainer loop) | "tick" (serve loop)
 
     @property
     def key(self) -> str:
         """Canonical id for the one-shot fire record."""
-        if self.kind == "stall":
-            return f"stall@step={self.step}:{self.secs}"
+        if self.kind in ("stall", "slow_client"):
+            return f"{self.kind}@{self.unit}={self.step}:{self.secs}"
         if self.kind == "io_fail":
             return f"io_fail@p={self.p}"
         if self.kind == "corrupt_ckpt":
             return "corrupt_ckpt@latest"
-        return f"{self.kind}@step={self.step}"
+        return f"{self.kind}@{self.unit}={self.step}"
 
 
 def parse_plan(spec: str) -> list[Fault]:
@@ -91,6 +105,14 @@ def parse_plan(spec: str) -> list[Fault]:
                     f"chaos clause {clause!r}: stall wants stall@step=N:SECS")
             faults.append(Fault(kind, step=step,
                                 secs=float(secs) if secs else 0.0))
+        elif m := _TICK_CLAUSE.match(clause):
+            kind, tick, secs = m.group(1), int(m.group(2)), m.group(3)
+            if kind in ("stall", "slow_client") and secs is None:
+                raise ValueError(
+                    f"chaos clause {clause!r}: {kind} wants "
+                    f"{kind}@tick=N:SECS")
+            faults.append(Fault(kind, step=tick, unit="tick",
+                                secs=float(secs) if secs else 0.0))
         elif _CKPT_CLAUSE.match(clause):
             faults.append(Fault("corrupt_ckpt"))
         elif m := _IO_CLAUSE.match(clause):
@@ -102,7 +124,9 @@ def parse_plan(spec: str) -> list[Fault]:
             raise ValueError(
                 f"unknown chaos clause {clause!r} (grammar: kill@step=N, "
                 "sigterm@step=N, nan_loss@step=N, stall@step=N:SECS, "
-                "corrupt_ckpt@latest, io_fail@p=X)")
+                "kill@tick=N, sigterm@tick=N, stall@tick=N:SECS, "
+                "slow_client@tick=N:SECS, corrupt_ckpt@latest, "
+                "io_fail@p=X)")
     return faults
 
 
@@ -151,7 +175,8 @@ class ChaosPlan:
         train. kill/sigterm/stall fire here; nan_loss fires in
         `poison_loss` (it needs the loss value path, not the process)."""
         for f in self.faults:
-            if f.step != step or f.kind not in ("kill", "sigterm", "stall"):
+            if f.unit != "step" or f.step != step \
+                    or f.kind not in ("kill", "sigterm", "stall"):
                 continue
             if not self._mark(f):
                 continue
@@ -176,6 +201,36 @@ class ChaosPlan:
             elif f.kind == "sigterm":
                 os.kill(os.getpid(), signal.SIGTERM)
             elif f.kind == "stall":
+                time.sleep(f.secs)
+
+    def on_tick(self, tick: int) -> None:
+        """Serve-loop hook (serve/engine.py calls this before decode
+        tick N): the kill/sigterm/stall family scoped to serving. A
+        stall here freezes the engine's host loop — heartbeats stop,
+        which is the exact signature `obs doctor` classifies as hung."""
+        for f in self.faults:
+            if f.unit != "tick" or f.step != tick \
+                    or f.kind not in ("kill", "sigterm", "stall"):
+                continue
+            if not self._mark(f):
+                continue
+            print(f"[chaos] firing {f.key}", flush=True)
+            if f.kind == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif f.kind == "sigterm":
+                os.kill(os.getpid(), signal.SIGTERM)
+            elif f.kind == "stall":
+                time.sleep(f.secs)
+
+    def on_client(self, tick: int) -> None:
+        """slow_client@tick=N:SECS — fired inside the engine's token
+        DELIVERY path: the consumer side wedges (dead socket, blocked
+        pipe) while the device side is healthy, backpressuring the
+        serve loop from the client edge."""
+        for f in self.faults:
+            if f.kind == "slow_client" and f.unit == "tick" \
+                    and f.step == tick and self._mark(f):
+                print(f"[chaos] firing {f.key}", flush=True)
                 time.sleep(f.secs)
 
     def poison_loss(self, step: int, loss: float) -> float:

@@ -66,6 +66,9 @@ GOOD_PROBE = {"ok": True, "platform": "tpu", "device_kind": "v5e"}
 CPU_PROBE = {"ok": False, "platform": "cpu", "device_kind": "cpu"}
 GOOD_PIPELINE = {"sync_batches_per_s": 300.0,
                  "prefetch_batches_per_s": 360.0, "speedup": 1.2}
+GOOD_SERVING = {"tokens_per_s": 650.0, "ttft_p50_ms": 12.0,
+                "ttft_p99_ms": 40.0, "reject_rate": 0.0,
+                "completed": 32, "rejected": 0}
 GOOD_MEASUREMENT = {
     "tflops": 150.0, "per_iter_ms": 7.0, "amortized_ms": 7.0,
     "dispatch_overhead_ms": 60.0, "chain_lengths": [16, 48],
@@ -99,6 +102,7 @@ class TestBenchMain:
             "--child-lm-step": (100, {"lm_step_ms": 30.0,
                                       "lm_tokens_per_s": 1e5}, ""),
             "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
+            "--child-serving": (30, GOOD_SERVING, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -106,6 +110,7 @@ class TestBenchMain:
         assert out["platform"] == "tpu"
         assert "extra" in out and "lm_step_ms" in out["extra"]
         assert out["input_pipeline"]["speedup"] == 1.2
+        assert out["serving"]["tokens_per_s"] == 650.0
 
     def test_dead_tunnel_emits_failure_with_sanity(self, bench, clock,
                                                    capsys, monkeypatch):
@@ -116,6 +121,7 @@ class TestBenchMain:
             "--child-matmul": (10_000, None, ""),
             "--child-cpu-sanity": (60, {"cpu_matmul_1024_tflops": 0.1}, ""),
             "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
+            "--child-serving": (30, GOOD_SERVING, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -124,9 +130,11 @@ class TestBenchMain:
         # specific) timeout becomes the recorded error
         assert "timed out" in out["error"]
         assert out["cpu_sanity"]["cpu_matmul_1024_tflops"] == 0.1
-        # the chip-free input-pipeline row rides the failure line too,
-        # budget permitting — history stays continuous on dead rounds
+        # the chip-free input-pipeline and serving rows ride the
+        # failure line too, budget permitting — history stays
+        # continuous on dead rounds
         assert "input_pipeline" in out
+        assert "serving" in out
         # total simulated wall time stayed inside the deadline
         assert clock.t - 1000.0 <= bench.DEADLINE_S
 
@@ -138,6 +146,7 @@ class TestBenchMain:
             "--child-probe": (20, CPU_PROBE, ""),
             "--child-cpu-sanity": (60, {"cpu_matmul_1024_tflops": 0.1}, ""),
             "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
+            "--child-serving": (30, GOOD_SERVING, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -178,6 +187,7 @@ class TestBenchMain:
             "--child-matmul": (200, GOOD_MEASUREMENT, ""),
             "--child-lm-step": (100, {"lm_step_ms": 30.0}, ""),
             "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
+            "--child-serving": (30, GOOD_SERVING, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -187,7 +197,7 @@ class TestBenchMain:
         assert names[0] == "bench_start"
         for expected in ("probe_attempt", "probe_result",
                          "measure_attempt", "measure_result",
-                         "input_pipeline", "publish"):
+                         "input_pipeline", "serving", "publish"):
             assert expected in names, names
         publish = [json.loads(line)
                    for line in tele.read_text().splitlines()][-1]
@@ -203,6 +213,7 @@ class TestBenchMain:
             "--child-matmul": (10_000, None, ""),
             "--child-cpu-sanity": (10_000, None, ""),
             "--child-input-pipeline": (10_000, None, ""),
+            "--child-serving": (10_000, None, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
