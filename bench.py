@@ -285,32 +285,36 @@ def _child_input_pipeline() -> None:
 
 def _child_serving() -> None:
     """Serving probe: the continuous-batching engine (serve/engine.py)
-    on the host backend under a seeded Poisson load (mixed prompt
-    lengths, serve/loadgen.py), reporting the user-facing SLOs —
-    tokens/sec, TTFT p50/p99, reject rate. Chip-free like the
-    input_pipeline probe (the parent forces JAX_PLATFORMS=cpu), so the
-    row survives dead-tunnel rounds and `obs diff` gates serving
-    regressions like any other metric. The tiny queue capacity is
-    deliberate: a probe that never rejects can't regress on
-    backpressure."""
+    on the host backend under a seeded Poisson load (serve/loadgen.py)
+    with a 64-token SHARED system prompt, reporting the user-facing
+    SLOs — tokens/sec, TTFT p50/p99, reject rate — plus the paged-KV-
+    cache pressure keys (prefix hit rate, prefill tokens saved, blocks
+    in use, HBM per request) that `obs diff` gates like throughput.
+    Chip-free like the input_pipeline probe (the parent forces
+    JAX_PLATFORMS=cpu), so the row survives dead-tunnel rounds. The
+    tiny queue capacity is deliberate: a probe that never rejects
+    can't regress on backpressure, and a probe whose requests share a
+    prefix can't silently lose the radix cache."""
     import jax
 
     from hyperion_tpu.models.llama import Llama, llama_tiny_config
     from hyperion_tpu.serve.engine import Engine, EngineConfig
     from hyperion_tpu.serve.loadgen import LoadSpec, run_load
 
-    cfg = llama_tiny_config(max_len=64)
+    cfg = llama_tiny_config(max_len=128)
     model = Llama(cfg)
     params = model.init_params(jax.random.key(0), seq=8)
     engine = Engine(
         model, {"params": params},
-        EngineConfig(slots=4, max_len=64, eos_id=None,
-                     queue_capacity=8, prefill_budget=64),
+        EngineConfig(slots=4, max_len=128, eos_id=None,
+                     queue_capacity=8, prefill_budget=96),
     )
+    shared = 64
     spec = LoadSpec(n_requests=32, rate_hz=100.0,
                     prompt_lens=(4, 8, 16), max_new=(4, 8, 12),
-                    vocab=cfg.vocab_size, seed=0)
-    engine.warmup(list(spec.prompt_lens))
+                    vocab=cfg.vocab_size, seed=0,
+                    shared_prefix_tokens=shared)
+    engine.warmup([shared + p for p in spec.prompt_lens])
     report = run_load(engine, spec)
     report["compile"] = engine.compile_stats()
     print(json.dumps(report))

@@ -219,7 +219,8 @@ class LlamaAttention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, rope_table, padding_mask, cache=None, cache_index=None):
+    def __call__(self, x, rope_table, padding_mask, cache=None, cache_index=None,
+                 block_tables=None):
         """Training path: cache=None → [B, T, d] out. Decode path:
         `cache` = {'k','v': [B, max_len, Hkv, D]} with `cache_index`
         tokens already filled → (out, updated cache); the T new
@@ -227,7 +228,17 @@ class LlamaAttention(nn.Module):
         the filled prefix (dense left-to-right prompts only — no
         padding_mask in the cached path). `cache_index` may be a [B]
         vector of per-row depths (the serve engine's slots decode
-        independent requests from one batched cache)."""
+        independent requests from one batched cache).
+
+        Paged path: `block_tables` [B, MB] int32 switches `cache` to a
+        pooled layout {'k','v': [num_blocks, block_size, Hkv, D]}
+        (`init_paged_cache`): logical position p of row b lives at
+        physical block `block_tables[b, p // bs]`, offset `p % bs`.
+        Writes scatter through the table; reads gather each row's
+        blocks back into a contiguous [B, MB*bs] view and run the same
+        masked grouped attention. Out-of-range or unmapped positions
+        route to physical block 0 (the serve engine's null block), so
+        bucket padding can never corrupt a neighbour's blocks."""
         c = self.cfg
         dense = _dense_ctor(c)
         q = dense(features=(c.n_heads, c.head_dim), name="q_proj")(x)
@@ -237,6 +248,40 @@ class LlamaAttention(nn.Module):
         q = apply_rope(q, rope_table, offset)
         k = apply_rope(k, rope_table, offset)
         rep = c.n_heads // c.n_kv_heads
+
+        if cache is not None and block_tables is not None:
+            B, T = x.shape[0], x.shape[1]
+            bs = cache["k"].shape[1]
+            MB = block_tables.shape[1]
+            L = MB * bs
+            idx = jnp.asarray(cache_index, jnp.int32)
+            base = idx if idx.ndim == 1 else jnp.full((B,), idx, jnp.int32)
+            cols = base[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+            # physical address of each new position; anything the table
+            # does not cover lands in the null block, where garbage
+            # (bucket padding, inactive lanes) is harmless by contract
+            phys = jnp.where(
+                cols < L,
+                jnp.take_along_axis(
+                    block_tables, jnp.clip(cols // bs, 0, MB - 1), axis=1),
+                jnp.int32(0),
+            )
+            off = cols % bs
+            ck = cache["k"].at[phys, off].set(k.astype(cache["k"].dtype))
+            cv = cache["v"].at[phys, off].set(v.astype(cache["v"].dtype))
+            # gather each row's chain into the contiguous view the
+            # grouped attention expects; rows beyond a row's frontier
+            # are masked off exactly as in the slab layout
+            vk = ck[block_tables].reshape(B, L, ck.shape[2], ck.shape[3])
+            vv = cv[block_tables].reshape(B, L, cv.shape[2], cv.shape[3])
+            kv_pos = jax.lax.broadcasted_iota(jnp.int32, (T, L), 1)
+            q_pos = base[:, None, None] + \
+                jax.lax.broadcasted_iota(jnp.int32, (T, L), 0)[None]
+            mask = kv_pos[None] <= q_pos  # [B, T, L]
+            out = _grouped_cache_attention(q, vk, vv, mask, rep)
+            return dense(
+                features=c.d_model, axis=(-2, -1), name="o_proj"
+            )(out), {"k": ck, "v": cv}
 
         if cache is not None:
             T = x.shape[1]
@@ -305,12 +350,14 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, rope_table, padding_mask, cache=None, cache_index=None):
+    def __call__(self, x, rope_table, padding_mask, cache=None, cache_index=None,
+                 block_tables=None):
         c = self.cfg
         h = RMSNorm(c.norm_eps, c.compute_dtype, c.norm_impl, name="input_norm")(x)
         attn = LlamaAttention(c, name="attn")
         if cache is not None:
-            a, cache = attn(h, rope_table, None, cache, cache_index)
+            a, cache = attn(h, rope_table, None, cache, cache_index,
+                            block_tables)
         else:
             a = attn(h, rope_table, padding_mask)
         x = x + a
@@ -337,18 +384,44 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None,
     ]
 
 
+def init_paged_cache(cfg: LlamaConfig, num_blocks: int, block_size: int,
+                     dtype=None) -> list[dict]:
+    """Per-layer pooled KV cache for block-table decoding: physical
+    block 0 is the null block (serve/blocks.py routes masked writes
+    there), blocks 1..num_blocks-1 are allocatable. Logical positions
+    addressed through a table must still stay under cfg.max_len — the
+    rope table is the binding constraint, exactly as for `init_cache`."""
+    dtype = dtype or cfg.compute_dtype
+    shape = (num_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    return [
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for _ in range(cfg.n_layers)
+    ]
+
+
+def paged_cache_block_bytes(cfg: LlamaConfig, block_size: int,
+                            dtype=None) -> int:
+    """HBM bytes one physical block costs across all layers (K and V) —
+    the unit the serve cache-pressure gauges are denominated in."""
+    dtype = jnp.dtype(dtype or cfg.compute_dtype)
+    return (2 * cfg.n_layers * block_size * cfg.n_kv_heads
+            * cfg.head_dim * dtype.itemsize)
+
+
 class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
     def __call__(self, input_ids, padding_mask=None, deterministic: bool = True,
-                 cache=None, cache_index=None):
+                 cache=None, cache_index=None, block_tables=None):
         """input_ids int32 [B, T] → logits fp32 [B, T, vocab].
 
         Decode path: pass `cache` (from `init_cache`) and `cache_index`
         (tokens already filled) → (logits, updated cache). Used for both
         prefill (T = prompt length, cache_index 0) and single-token
-        steps (T = 1)."""
+        steps (T = 1). With `block_tables` [B, MB], `cache` is the
+        pooled `init_paged_cache` layout and positions are addressed
+        block-table-first (the serve engine's paged slots)."""
         c = self.cfg
         x = nn.Embed(
             c.vocab_size, c.d_model, dtype=c.compute_dtype,
@@ -366,7 +439,8 @@ class Llama(nn.Module):
             if cache is None:
                 x = blk(x, rope, padding_mask)
             else:
-                x, layer_cache = blk(x, rope, None, cache[i], cache_index)
+                x, layer_cache = blk(x, rope, None, cache[i], cache_index,
+                                     block_tables)
                 new_cache.append(layer_cache)
         x = RMSNorm(c.norm_eps, c.compute_dtype, c.norm_impl, name="final_norm")(x)
         logits = _dense_ctor(c)(features=c.vocab_size, name="lm_head")(x)

@@ -52,6 +52,12 @@ METRICS: dict[str, str] = {
     "serve_ttft_p50_ms": "lower",
     "serve_ttft_p99_ms": "lower",
     "serve_reject_rate": "lower",
+    # paged-KV-cache pressure (serve/blocks.py): hit rate falling, or
+    # blocks/HBM-per-request rising, means lost sharing — the same
+    # capacity regression as a throughput drop, gated the same way
+    "serve_prefix_hit_rate": "higher",
+    "serve_blocks_in_use": "lower",
+    "serve_hbm_per_req_mb": "lower",
 }
 
 
@@ -108,7 +114,10 @@ def normalize(doc: dict) -> dict[str, float]:
             for src, name in (("tokens_per_s", "serve_tokens_per_s"),
                               ("ttft_p50_ms", "serve_ttft_p50_ms"),
                               ("ttft_p99_ms", "serve_ttft_p99_ms"),
-                              ("reject_rate", "serve_reject_rate")):
+                              ("reject_rate", "serve_reject_rate"),
+                              ("prefix_hit_rate", "serve_prefix_hit_rate"),
+                              ("blocks_in_use", "serve_blocks_in_use"),
+                              ("hbm_per_req_mb", "serve_hbm_per_req_mb")):
                 v = _num(srv.get(src))
                 if v is not None:
                     out[name] = v

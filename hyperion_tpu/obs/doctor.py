@@ -202,6 +202,13 @@ def diagnose(
                 "tokens_per_s": g.get("tokens_per_s"),
                 "ttft_p50_ms": ttft.get("p50"),
                 "ttft_p99_ms": ttft.get("p99"),
+                # paged-KV-cache pressure (serve/blocks.py)
+                "preempted": c.get("serve_preempted"),
+                "prefix_lookups": c.get("serve_prefix_lookups"),
+                "prefix_hits": c.get("serve_prefix_hits"),
+                "prefix_hit_rate": g.get("serve_prefix_hit_rate"),
+                "blocks_in_use": g.get("serve_blocks_in_use"),
+                "hbm_per_req_mb": g.get("serve_hbm_per_req_mb"),
             }
 
     # ---- stall signal: tail steps vs the run's own earlier median ----
@@ -304,6 +311,29 @@ def diagnose(
             else f"; input-bound: input_wait_frac={input_frac:.2f}"
         )
 
+    # Cache-pressure incidents (paged serve KV cache) — also orthogonal
+    # to liveness: a run that preempted its way through an undersized
+    # pool "completes", just slowly, and a shared-prefix workload that
+    # never hit the prefix cache silently re-prefilled every prompt.
+    # Both are sizing/config bugs worth naming, not just slow numbers.
+    cache_pressure: list[str] = []
+    if serve and serve.get("preempted"):
+        cache_pressure.append(
+            f"{int(serve['preempted'])} pool-exhaustion preemption(s) — "
+            "--num-blocks likely undersized for this load")
+    shared_wl = next(
+        (e for e in events if e.get("name") == "serve_workload"
+         and e.get("shared_prefix_tokens")), None)
+    if shared_wl is not None and serve and serve.get("prefix_lookups") \
+            and not serve.get("prefix_hits"):
+        cache_pressure.append(
+            f"shared-prefix workload ({shared_wl['shared_prefix_tokens']} "
+            "common tokens) saw ZERO prefix hits — prefix cache disabled "
+            "or --block-size larger than the shared prefix")
+    if cache_pressure and verdict in ("healthy", "running", "stalled",
+                                      "failed"):
+        reason += "; cache pressure: " + "; ".join(cache_pressure)
+
     last_span = spans[-1] if spans else None
     return {
         "target": str(target),
@@ -339,6 +369,7 @@ def diagnose(
         ],
         "hbm_peak_mb": hbm_peak,
         "serve": serve,
+        "cache_pressure": cache_pressure,
         "heartbeat": {
             "phase": hb.get("phase"), "step": hb.get("step"),
             "pid": hb.get("pid"), "beats": hb.get("beats"),
@@ -431,6 +462,15 @@ def render_markdown(d: dict) -> str:
             lines.append(
                 f"| TTFT p50 / p99 | {_fmt(srv['ttft_p50_ms'])} / "
                 f"{_fmt(srv['ttft_p99_ms'])} ms |")
+        if srv.get("blocks_in_use") is not None \
+                or srv.get("prefix_lookups") is not None:
+            flag = " — **cache pressure**" if d.get("cache_pressure") else ""
+            lines.append(
+                f"| serve KV cache | blocks in use "
+                f"{_fmt(srv.get('blocks_in_use'))}, prefix hit rate "
+                f"{_fmt(srv.get('prefix_hit_rate'))}, preempted "
+                f"{_fmt(srv.get('preempted'))}, HBM/req "
+                f"{_fmt(srv.get('hbm_per_req_mb'))} MB{flag} |")
     hb = d.get("heartbeat")
     if hb:
         lines.append(

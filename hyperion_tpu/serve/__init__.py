@@ -1,17 +1,25 @@
-"""Serving layer — continuous batching over the KV-cache decode path.
+"""Serving layer — continuous batching over the paged KV decode path.
 
 The ROADMAP's north star is a system that serves heavy traffic;
 `infer/generate.py` gives one process one prompt and one exit. This
 package is the request path between those: an Orca-style
-continuous-batching engine on a static-shape `[slots, max_len]` KV
-cache (`engine`), a bounded admission queue with backpressure,
-deadlines, and a prefill budget (`queue`), serving SLO gauges on the
-obs registry (`metrics`), a JSONL stdin/socket front-end + client
-(`server`, `client`), and a deterministic Poisson load driver
-(`loadgen`). `SERVING.md` documents the static-shape slot design and
-why recompile-free refill is the whole game on TPU.
+continuous-batching engine on a paged `[num_blocks, block_size]` KV
+pool addressed through per-slot block tables (`engine`), the host-side
+block manager + radix prefix cache that make a shared system prompt
+prefill once and copy-on-write share thereafter (`blocks`), a bounded
+admission queue with backpressure, deadlines, a prefill budget, and a
+block-availability gate (`queue`), serving SLO + cache-pressure gauges
+on the obs registry (`metrics`), a JSONL stdin/socket front-end +
+client (`server`, `client`), and a deterministic Poisson load driver
+with a shared-prefix workload mode (`loadgen`). `SERVING.md` documents
+the paged design and why recompile-free refill is the whole game on
+TPU.
 """
 
+from hyperion_tpu.serve.blocks import (  # noqa: F401
+    BlockManager,
+    RadixPrefixCache,
+)
 from hyperion_tpu.serve.engine import Engine, EngineConfig, TokenEvent  # noqa: F401
 from hyperion_tpu.serve.loadgen import LoadSpec, run_load  # noqa: F401
 from hyperion_tpu.serve.metrics import ServeMetrics  # noqa: F401

@@ -1,5 +1,5 @@
 """Slot-based continuous-batching decode engine — Orca-style iteration
-scheduling on a static-shape TPU cache.
+scheduling on a paged, prefix-shared TPU cache.
 
 The single-shot path (`infer/generate.py`) decodes ONE batch of aligned
 prompts: prefill, then a `lax.scan` that every request enters and
@@ -10,42 +10,51 @@ slowest member wastes every other slot's ticks. Continuous batching
 decode TICK, and membership of the batch is re-decided between ticks.
 
 TPU constraint that shapes everything here: **recompilation is the
-enemy.** XLA specializes on shapes, so the naive design — re-batch
-active requests into a [n_active, ...] tensor each tick — compiles a
-new executable every time occupancy changes. Instead:
+enemy.** XLA specializes on shapes, so every device-side structure is
+shape-fixed at construction and the tick/prefill executables compile
+once, at warmup, forever:
 
-  * The KV cache is a fixed `[S, L]` slab (`S` slots × `L` tokens,
-    `models/llama.py:init_cache` buffers batched over slots). A slot
-    holds one request; a finished slot is refilled from the queue
-    without the shapes ever changing. The decode tick is compiled
-    ONCE, at warmup, forever.
+  * The KV cache is a `[num_blocks, block_size]` POOL
+    (`models/llama.py:init_paged_cache`), not a per-slot slab. A slot
+    addresses it through a block table (`serve/blocks.py`): logical
+    position p lives at physical block `bt[slot, p // bs]`. HBM burn
+    tracks tokens actually held, not `slots × max_len`, and two slots
+    whose prompts share a prefix share the physical blocks outright
+    (PagedAttention — Kwon et al., SOSP '23). The table itself is a
+    tiny `[S, MB]` int32 host array shipped with each jitted call, so
+    block churn never touches compiled code.
   * Every per-request quantity the tick needs — cache depth, eos
     latch, remaining budget, temperature/top_k/top_p, PRNG key — is a
     `[S]` device array threaded through the jitted call, so slot
     churn is a cheap scatter into state rows, never a retrace.
-  * Per-slot attention masks key on per-slot lengths: slot b's query
-    at depth `lengths[b]` attends cache rows `0..lengths[b]` of its
-    own row only (the vector-`cache_index` path in
-    `models/llama.py:LlamaAttention`). Inactive slots still compute —
-    static shapes make their lanes free compared to a recompile — and
-    their outputs are discarded on the host.
-  * Prefill for a joining request is a SEPARATE jitted call per
-    prompt-length bucket (next power of two): it runs the prompt
-    through the cached forward at batch 1, scatters the K/V block into
-    the free slot's row, samples the first token (TTFT ends here), and
-    stamps the slot's state row. Buckets make prompt-length variety a
-    handful of warmup compiles instead of one per length.
+  * A radix prefix cache (`serve/blocks.py:RadixPrefixCache`) maps
+    token prefixes to retained block chains: a shared system prompt is
+    prefilled ONCE, and every later request that starts with it skips
+    straight to its own suffix — the prefill jit runs on the suffix
+    bucket, attending over the shared blocks through the table. A
+    prompt that diverges mid-block still reuses the agreeing positions
+    via one copy-on-write block copy (the `copy` jit).
+  * Admission is block-aware: the queue only pops a request when its
+    worst-case block demand fits (`can_admit` — free + evictable
+    radix blocks minus outstanding reservations). Under `optimistic`
+    admission the pool can still exhaust mid-decode; the engine then
+    PREEMPTS the youngest slot back to the queue head (its generated
+    tokens ride along and re-prefill, usually from its own still-
+    cached prefix) instead of crashing.
 
 Semantics contract (the oracle `tests/test_serve.py` pins): at
 temperature 0 a request decoded through this engine — while other
-slots churn arbitrarily — emits **bit-identical tokens** to
-`infer/generate.generate` on the same prompt. Every per-slot op above
-is row-independent, so sharing the batch costs nothing semantically.
+slots churn, share its blocks, or preempt around it — emits
+**bit-identical tokens** to `infer/generate.generate` on the same
+prompt. K/V at position p depend only on tokens 0..p, so shared blocks
+hold exactly the values each sharer would have computed, and every
+per-slot op is row-independent.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import time
 from typing import Any, Callable
 
@@ -54,22 +63,150 @@ import jax.numpy as jnp
 import numpy as np
 
 from hyperion_tpu.infer.generate import sample_token_slots
+from hyperion_tpu.serve.blocks import (
+    BlockManager,
+    RadixPrefixCache,
+    SeqAlloc,
+    blocks_for,
+)
 from hyperion_tpu.serve.metrics import ServeMetrics
 from hyperion_tpu.serve.queue import AdmissionQueue, Request
 
 _SNAPSHOT_EVERY = 32  # ticks between metric snapshots on the stream
 
 
+# --- the three compiled surfaces, shared process-wide -----------------
+# Module-level bodies with the model/eos/pad as STATIC jit arguments:
+# every Engine in a process shares one jit cache per surface, so two
+# engines over the same model and shapes (the test suite's shape, and
+# any multi-engine deployment's) compile each executable exactly once.
+
+def _tick_impl(model, eos_id, pad_id, variables, cache, st, bt, live):
+    # every live slot advances one token: write last_token's K/V at
+    # its own depth through its block-table row, attend its own
+    # filled prefix (gathered from the pool), sample with its own
+    # params. Dead lanes (freed or preempted — `live` is the host's
+    # slot table shipped as a mask) still compute but write to the
+    # null block and emit pad.
+    act = st["active"] & live
+    logits, cache = model.apply(
+        variables, st["last_token"][:, None],
+        cache=cache, cache_index=st["lengths"], block_tables=bt,
+    )
+    keys = jax.vmap(jax.random.fold_in)(st["keys"], st["lengths"])
+    nxt = sample_token_slots(
+        logits[:, 0], keys,
+        st["temperature"], st["top_k"], st["top_p"],
+    )
+    nxt = jnp.where(act, nxt, jnp.int32(pad_id))
+    adv = act.astype(jnp.int32)
+    gen = st["generated"] + adv
+    lengths = st["lengths"] + adv
+    hit_eos = (nxt == eos_id) if eos_id is not None \
+        else jnp.zeros_like(act)
+    finished = act & (hit_eos | (gen >= st["budget"]))
+    st = {
+        **st,
+        "last_token": jnp.where(act, nxt, st["last_token"]),
+        "generated": gen,
+        "lengths": lengths,
+        "active": act & ~finished,
+    }
+    return cache, st, nxt, finished
+
+
+def _prefill_impl(model, eos_id, variables, cache, st, prompt, bt_row,
+                  slot, start, true_len, temperature, top_k, top_p,
+                  budget, key):
+    # prompt [1, Pb]: the UNCACHED suffix, bucket-padded, whose
+    # positions are start..start+Pb-1. `start` > 0 is a prefix-cache
+    # hit: positions 0..start-1 already sit in shared blocks of bt_row
+    # and are attended, never recomputed. Pad positions beyond the
+    # table's coverage write to the null block (the model routes
+    # them); pad K/V inside the tail block is masked until decode
+    # overwrites it position by position. Compiled once per bucket.
+    logits, cache = model.apply(
+        variables, prompt, cache=cache, cache_index=start,
+        block_tables=bt_row[None],
+    )
+    last = jax.lax.dynamic_slice_in_dim(
+        logits[0], true_len - 1, 1, axis=0)  # [1, V]
+    # fold position = (total prompt length - 1): identical whether the
+    # prefix came from cache or compute, so a hit never shifts the
+    # sampling stream
+    fkey = jax.random.fold_in(key, start + true_len - 1)
+    first = sample_token_slots(
+        last, fkey[None], temperature[None], top_k[None], top_p[None],
+    )[0]
+    hit_eos = (first == eos_id) if eos_id is not None else False
+    finished = jnp.logical_or(hit_eos, budget <= 1)
+    st = {
+        "lengths": st["lengths"].at[slot].set(start + true_len),
+        "active": st["active"].at[slot].set(~finished),
+        "last_token": st["last_token"].at[slot].set(first),
+        "generated": st["generated"].at[slot].set(1),
+        "budget": st["budget"].at[slot].set(budget),
+        "temperature": st["temperature"].at[slot].set(temperature),
+        "top_k": st["top_k"].at[slot].set(top_k),
+        "top_p": st["top_p"].at[slot].set(top_p),
+        "keys": st["keys"].at[slot].set(key),
+    }
+    return cache, st, first, finished
+
+
+def _copy_impl(cache, src, dst):
+    # whole-block K/V copy (copy-on-write fork): dst becomes a private
+    # duplicate the writer may overwrite from its divergence offset
+    # onward. src/dst are [C] vectors so one executable serves every
+    # fork.
+    return [
+        {kv: layer[kv].at[dst].set(layer[kv][src]) for kv in ("k", "v")}
+        for layer in cache
+    ]
+
+
+_SHARED_JITS: dict[bool, tuple] = {}
+
+
+def _shared_jits(donate: bool) -> tuple:
+    """(tick, prefill, copy) jit objects, one set per donation mode.
+    Donation keeps the pool + state slabs in place on real chips; the
+    CPU backend ignores donation with a warning, so callers pass
+    donate=False there."""
+    if donate not in _SHARED_JITS:
+        _SHARED_JITS[donate] = (
+            jax.jit(_tick_impl, static_argnums=(0, 1, 2),
+                    donate_argnums=(4, 5) if donate else ()),
+            jax.jit(_prefill_impl, static_argnums=(0, 1),
+                    donate_argnums=(3, 4) if donate else ()),
+            jax.jit(_copy_impl,
+                    donate_argnums=(0,) if donate else ()),
+        )
+    return _SHARED_JITS[donate]
+
+
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
     slots: int = 4                 # S: concurrent requests in flight
-    max_len: int = 0               # L: per-slot cache length (0 = model max)
+    max_len: int = 0               # L: per-slot logical length (0 = model max)
     eos_id: int | None = None
     pad_id: int = 0
     queue_capacity: int = 64
     prefill_budget: int = 512      # prompt tokens admitted per round
     min_bucket: int = 8            # smallest prefill padding bucket
     snapshot_every: int = _SNAPSHOT_EVERY
+    # ---- paged cache ----
+    block_size: int = 16           # tokens per KV block
+    num_blocks: int = 0            # pool size incl. null block (0 = auto:
+    #                                slots * ceil(L/bs) + 1, the slab equivalent)
+    prefix_cache: bool = True      # radix prefix reuse on/off
+    # "reserve": a request only admits when its WORST-CASE block demand
+    # (prompt + full budget, minus shared prefix) is covered — pool
+    # exhaustion is impossible by accounting. "optimistic": admit on
+    # prompt-fit only, oversubscribe the growth, and preempt-to-queue
+    # when the pool runs dry (vLLM's default posture; higher occupancy,
+    # tail-latency risk under pathological growth).
+    admission: str = "reserve"
 
 
 @dataclasses.dataclass
@@ -85,10 +222,11 @@ class TokenEvent:
 class Engine:
     """Continuous-batching engine over one model + one variables tree.
 
-    Host-side it owns the slot table (slot index -> Request), the
-    admission queue, metrics, and telemetry; device-side the [S, L]
-    cache and the [S] state rows. `step()` is one scheduling round
-    (admit -> tick -> route); `run()` loops it."""
+    Host-side it owns the slot table (slot index -> Request), block
+    manager + radix cache, the admission queue, metrics, and telemetry;
+    device-side the `[num_blocks, block_size]` KV pool and the [S]
+    state rows. `step()` is one scheduling round (admit -> ensure
+    blocks -> tick -> route); `run()` loops it."""
 
     def __init__(
         self,
@@ -102,7 +240,10 @@ class Engine:
         chaos=None,
         on_event: Callable[[TokenEvent], Any] | None = None,
     ):
-        from hyperion_tpu.models.llama import init_cache
+        from hyperion_tpu.models.llama import (
+            init_paged_cache,
+            paged_cache_block_bytes,
+        )
         from hyperion_tpu.obs import heartbeat as hb_mod
         from hyperion_tpu.obs import trace as trace_mod
 
@@ -113,7 +254,18 @@ class Engine:
         if L > mcfg.max_len:
             raise ValueError(
                 f"engine max_len {L} exceeds model max_len {mcfg.max_len}")
-        self.cfg = dataclasses.replace(cfg, max_len=L)
+        if cfg.admission not in ("reserve", "optimistic"):
+            raise ValueError(f"admission must be 'reserve' or 'optimistic', "
+                             f"got {cfg.admission!r}")
+        bs = cfg.block_size
+        self._mb = blocks_for(L, bs)          # block-table width per slot
+        num_blocks = cfg.num_blocks or cfg.slots * self._mb + 1
+        if num_blocks < self._mb + 1:
+            raise ValueError(
+                f"num_blocks {num_blocks} cannot hold one worst-case "
+                f"request ({self._mb} blocks + the null block); raise "
+                f"--num-blocks or --block-size")
+        self.cfg = dataclasses.replace(cfg, max_len=L, num_blocks=num_blocks)
         self.queue = AdmissionQueue(
             cfg.queue_capacity, max_total_tokens=L,
             prefill_budget=cfg.prefill_budget,
@@ -125,16 +277,19 @@ class Engine:
         self.chaos = chaos
         self.on_event = on_event
         self._slots: list[Request | None] = [None] * cfg.slots
-        self._cache = init_cache(mcfg, cfg.slots, max_len=L)
+        self._seqs: list[SeqAlloc | None] = [None] * cfg.slots
+        self.mgr = BlockManager(num_blocks, bs)
+        self.prefix = RadixPrefixCache(self.mgr) if cfg.prefix_cache else None
+        self._bt = np.zeros((cfg.slots, self._mb), np.int32)
+        self._bt_dev = None   # device mirror of (_bt, live); None = stale
+        self._pending_reserve: dict[str, int] = {}
+        self._order = itertools.count()
+        self._block_bytes = paged_cache_block_bytes(mcfg, bs)
+        self._cache = init_paged_cache(mcfg, num_blocks, bs)
         self._state = self._init_state()
         self._tick_no = 0
-        # donation keeps the [S, L, Hkv, D] slabs in place on real
-        # chips; the CPU backend ignores donation with a warning, so
-        # don't ask there
-        donate = () if jax.default_backend() == "cpu" else (1, 2)
-        self._tick_jit = jax.jit(self._make_tick(), donate_argnums=donate)
-        self._prefill_jit = jax.jit(self._make_prefill(),
-                                    donate_argnums=donate)
+        self._tick_jit, self._prefill_jit, self._copy_jit = _shared_jits(
+            donate=jax.default_backend() != "cpu")
 
     # ------------------------------------------------------ device state
 
@@ -152,88 +307,6 @@ class Engine:
             "keys": jax.random.split(jax.random.key(0), S),
         }
 
-    def _make_tick(self):
-        model, eos_id, pad_id = self.model, self.cfg.eos_id, self.cfg.pad_id
-
-        def tick(variables, cache, st):
-            # every slot advances one token: write last_token's K/V at
-            # its own depth, attend its own filled prefix, sample with
-            # its own params. Inactive lanes compute too (static
-            # shapes); their results are masked to pad and never
-            # delivered.
-            logits, cache = model.apply(
-                variables, st["last_token"][:, None],
-                cache=cache, cache_index=st["lengths"],
-            )
-            keys = jax.vmap(jax.random.fold_in)(st["keys"], st["lengths"])
-            nxt = sample_token_slots(
-                logits[:, 0], keys,
-                st["temperature"], st["top_k"], st["top_p"],
-            )
-            nxt = jnp.where(st["active"], nxt, jnp.int32(pad_id))
-            adv = st["active"].astype(jnp.int32)
-            gen = st["generated"] + adv
-            lengths = st["lengths"] + adv
-            hit_eos = (nxt == eos_id) if eos_id is not None \
-                else jnp.zeros_like(st["active"])
-            finished = st["active"] & (hit_eos | (gen >= st["budget"]))
-            st = {
-                **st,
-                "last_token": jnp.where(st["active"], nxt,
-                                        st["last_token"]),
-                "generated": gen,
-                "lengths": lengths,
-                "active": st["active"] & ~finished,
-            }
-            return cache, st, nxt, finished
-
-        return tick
-
-    def _make_prefill(self):
-        from hyperion_tpu.models.llama import init_cache
-
-        model, eos_id = self.model, self.cfg.eos_id
-        mcfg = model.cfg
-
-        def prefill(variables, cache, st, prompt, slot, true_len,
-                    temperature, top_k, top_p, budget, key):
-            # prompt [1, Pb] (bucket-padded; pad K/V beyond true_len is
-            # written but masked until decode overwrites it position by
-            # position). Compiled once per bucket length.
-            Pb = prompt.shape[1]
-            small = init_cache(mcfg, 1, max_len=Pb)
-            logits, small = model.apply(
-                variables, prompt, cache=small, cache_index=0)
-            for layer, filled in zip(cache, small):
-                for kv in ("k", "v"):
-                    layer[kv] = jax.lax.dynamic_update_slice(
-                        layer[kv], filled[kv].astype(layer[kv].dtype),
-                        (slot, 0, 0, 0),
-                    )
-            last = jax.lax.dynamic_slice_in_dim(
-                logits[0], true_len - 1, 1, axis=0)  # [1, V]
-            fkey = jax.random.fold_in(key, true_len - 1)
-            first = sample_token_slots(
-                last, fkey[None], temperature[None], top_k[None],
-                top_p[None],
-            )[0]
-            hit_eos = (first == eos_id) if eos_id is not None else False
-            finished = jnp.logical_or(hit_eos, budget <= 1)
-            st = {
-                "lengths": st["lengths"].at[slot].set(true_len),
-                "active": st["active"].at[slot].set(~finished),
-                "last_token": st["last_token"].at[slot].set(first),
-                "generated": st["generated"].at[slot].set(1),
-                "budget": st["budget"].at[slot].set(budget),
-                "temperature": st["temperature"].at[slot].set(temperature),
-                "top_k": st["top_k"].at[slot].set(top_k),
-                "top_p": st["top_p"].at[slot].set(top_p),
-                "keys": st["keys"].at[slot].set(key),
-            }
-            return cache, st, first, finished
-
-        return prefill
-
     # --------------------------------------------------------- plumbing
 
     def bucket(self, prompt_len: int) -> int:
@@ -246,55 +319,285 @@ class Engine:
         return min(b, self.cfg.max_len)
 
     def compile_stats(self) -> dict:
-        """Executable counts in the two jit caches — the no-recompile
+        """Executable counts in the three jit caches — the no-recompile
         guarantee made measurable (tier-1 asserts these stay flat
-        across slot churn after `warmup`)."""
+        across slot churn, prefix hits, COW forks, and preemptions
+        after `warmup`). The caches are PROCESS-wide (`_shared_jits`):
+        engines over the same model and shapes share executables, so a
+        second engine's warmup is free — counts only ever grow, and
+        flatness between two readings still means "nothing traced"."""
         return {
             "tick_executables": self._tick_jit._cache_size(),
             "prefill_executables": self._prefill_jit._cache_size(),
+            "copy_executables": self._copy_jit._cache_size(),
         }
 
     def warmup(self, prompt_lens: list[int] | None = None) -> dict:
-        """Compile the tick and one prefill per bucket up front, then
-        reset serving state. After this, admission/refill/decode never
-        traces again — a request joining mid-flight costs a scatter,
-        not a compile."""
-        lens = sorted({self.bucket(p) for p in (prompt_lens or
-                                                [self.cfg.min_bucket])})
+        """Compile the tick, the COW block copy, and one prefill per
+        bucket, then reset serving state. The ladder covers EVERY
+        bucket at or below the largest reachable suffix, not just the
+        requested lengths: a prefix-cache hit shrinks a prompt to its
+        suffix, which may land in any smaller bucket, and a hit must
+        never cost a compile. Under `optimistic` admission the ladder
+        extends all the way to max_len regardless of `prompt_lens`,
+        because a pool-exhaustion preemption GROWS the prompt (the
+        resume re-prefills prompt + generated) — O(log max_len)
+        compiles, paid once. Under `reserve` admission nothing ever
+        grows (the only requeue path fires before a token exists), so
+        `prompt_lens` bounds the ladder."""
+        want = self.bucket(max(prompt_lens or [self.cfg.min_bucket]))
+        if self.cfg.admission == "optimistic":
+            want = self.cfg.max_len
+        lens: list[int] = []
+        b = self.cfg.min_bucket
+        while True:
+            pb = min(b, self.cfg.max_len)
+            if pb not in lens:
+                lens.append(pb)
+            if pb >= want:
+                break
+            b *= 2
         with self.tracer.span("serve_warmup") as sp:
             for pb in lens:
                 dummy = Request(prompt_ids=np.ones((min(pb, 2),), np.int32),
                                 max_new_tokens=2)
-                # pad to the exact bucket so the real compile happens
+                # bt row is all-null during warmup: the dummy's writes
+                # land in the garbage block, real state is untouched
                 self._prefill_call(dummy, slot=0, bucket_len=pb)
             _ = self._tick_device()
+            zero = jnp.zeros((1,), jnp.int32)
+            self._cache = self._copy_jit(self._cache, zero, zero)
             sp.set(buckets=lens)
         self._state = self._init_state()
         self._slots = [None] * self.cfg.slots
+        self._seqs = [None] * self.cfg.slots
+        self._bt[:] = 0
+        self._bt_dev = None
         stats = self.compile_stats()
         self.tracer.event("serve_warmup_done", **stats)
         return stats
 
-    def _prefill_call(self, req: Request, slot: int,
+    def _prefill_call(self, req: Request, slot: int, *, start: int = 0,
+                      prompt: np.ndarray | None = None,
+                      budget: int | None = None,
                       bucket_len: int | None = None):
-        P = req.prompt_len
+        ids = req.prompt_ids if prompt is None else prompt
+        suffix = ids[start:]
+        P = int(suffix.shape[0])
         Pb = bucket_len or self.bucket(P)
-        prompt = np.full((1, Pb), self.cfg.pad_id, np.int32)
-        prompt[0, :P] = req.prompt_ids
+        buf = np.full((1, Pb), self.cfg.pad_id, np.int32)
+        buf[0, :P] = suffix
         self._cache, self._state, first, finished = self._prefill_jit(
+            self.model, self.cfg.eos_id,
             self.variables, self._cache, self._state,
-            jnp.asarray(prompt), jnp.int32(slot), jnp.int32(P),
+            jnp.asarray(buf), jnp.asarray(self._bt[slot]),
+            jnp.int32(slot), jnp.int32(start), jnp.int32(P),
             jnp.float32(req.temperature), jnp.int32(req.top_k),
-            jnp.float32(req.top_p), jnp.int32(req.max_new_tokens),
+            jnp.float32(req.top_p),
+            jnp.int32(req.max_new_tokens if budget is None else budget),
             jax.random.key(req.seed),
         )
         return int(first), bool(finished)
 
     def _tick_device(self):
+        if self._bt_dev is None:
+            # upload only when the table or slot liveness changed —
+            # steady-state decode re-uses the device copies, so a tick
+            # costs zero host->device traffic
+            live = np.fromiter((r is not None for r in self._slots),
+                               bool, len(self._slots))
+            self._bt_dev = (jnp.asarray(self._bt), jnp.asarray(live))
         self._cache, self._state, toks, fins = self._tick_jit(
-            self.variables, self._cache, self._state)
+            self.model, self.cfg.eos_id, self.cfg.pad_id,
+            self.variables, self._cache, self._state, *self._bt_dev)
         # the host fetch is the fence: tick spans time real work
         return np.asarray(toks), np.asarray(fins)
+
+    # --------------------------------------------------- block plumbing
+
+    def _effective(self, req: Request) -> tuple[np.ndarray, int]:
+        """(prompt, remaining budget) — preemption-aware: a preempted
+        request resumes by prefilling prompt + everything it already
+        generated (recompute preemption), which reproduces the exact
+        decode state it lost."""
+        if req.tokens:
+            prompt = np.concatenate(
+                [req.prompt_ids, np.asarray(req.tokens, np.int32)])
+            return prompt, req.max_new_tokens - len(req.tokens)
+        return req.prompt_ids, req.max_new_tokens
+
+    def _block_demand(self, req: Request) -> int:
+        """Exclusive new blocks this request needs — worst-case span
+        under `reserve` admission, prompt-only under `optimistic` —
+        net of blocks a radix hit would share."""
+        prompt, budget = self._effective(req)
+        P = int(prompt.shape[0])
+        span = P + budget if self.cfg.admission == "reserve" else P
+        need = blocks_for(span, self.cfg.block_size)
+        if self.prefix is not None:
+            need -= len(self.prefix.lookup(prompt, P - 1).blocks)
+        return need
+
+    def _can_admit(self, req: Request) -> bool:
+        """Block-availability gate for the queue: pop only when the
+        demand is covered by free + evictable-radix blocks, net of
+        reservations already promised to in-flight requests. Covered
+        demand is reserved immediately (released as real blocks are
+        claimed), so one scheduling round cannot double-spend."""
+        need = self._block_demand(req)
+        evictable = self.prefix.evictable() if self.prefix else 0
+        if need > self.mgr.num_free + evictable - self.mgr.reserved:
+            return False
+        self.mgr.reserve(need)
+        self._pending_reserve[req.id] = need
+        return True
+
+    def _alloc(self, n: int, seq: SeqAlloc | None = None) -> list[int] | None:
+        """Pool allocation with radix eviction backing; claims against
+        `seq`'s reservation when it holds one."""
+        blocks = self.mgr.alloc(n)
+        if blocks is None and self.prefix is not None:
+            freed = self.prefix.evict(n - self.mgr.num_free)
+            if freed:
+                self.metrics.on_evict(freed)
+            blocks = self.mgr.alloc(n)
+        if blocks is not None and seq is not None and seq.reserved:
+            take = min(seq.reserved, n)
+            seq.reserved -= take
+            self.mgr.release(take)
+        return blocks
+
+    def _free_slot(self, slot: int) -> None:
+        seq = self._seqs[slot]
+        if seq is not None:
+            self.mgr.release(seq.reserved)
+            self.mgr.decref(seq.blocks)
+        self._seqs[slot] = None
+        self._slots[slot] = None
+        self._bt[slot, :] = 0
+        self._bt_dev = None
+
+    def _admit(self, req: Request, slot: int) -> TokenEvent | None:
+        """Prefill `req` into `slot` through the paged pool: radix
+        lookup -> share/COW -> allocate exclusives -> prefill the
+        suffix -> register prompt blocks. Returns the first-token
+        event, or None when allocation lost a race (caller requeues)."""
+        reserve = self._pending_reserve.pop(req.id, 0)
+        prompt, budget = self._effective(req)
+        P = int(prompt.shape[0])
+        bs = self.cfg.block_size
+        shared: list[int] = []
+        cow_src: int | None = None
+        start = 0
+        if self.prefix is not None:
+            m = self.prefix.lookup(prompt, P - 1)
+            shared, start, cow_src = m.blocks, m.tokens, m.cow_src
+        need_now = blocks_for(P, bs) - len(shared)
+        # pin the matched chain (and the COW source) BEFORE allocating:
+        # allocation may evict radix holds, and a trie-only block we
+        # just matched is exactly what LRU eviction would pick off
+        pin = shared + ([cow_src] if cow_src is not None else [])
+        self.mgr.incref(pin)
+        fresh = self._alloc(need_now) if need_now else []
+        if fresh is None:
+            self.mgr.decref(pin)
+            self.mgr.release(reserve)
+            return None
+        # Re-derive the growth reservation instead of netting the
+        # gate's estimate against need_now: an earlier admission this
+        # round may have evicted blocks the gate counted as shared, and
+        # growth demand — blocks_for(P+budget) - blocks_for(P) — does
+        # not depend on sharing at all, so computing it directly keeps
+        # the reserve-mode "exhaustion impossible" ledger exact even
+        # when the gate's sharing estimate went stale.
+        self.mgr.release(reserve)
+        growth = 0
+        if self.cfg.admission == "reserve":
+            growth = blocks_for(P + budget, bs) - blocks_for(P, bs)
+            self.mgr.reserve(growth)
+        seq = SeqAlloc(
+            blocks=shared + fresh, n_shared=len(shared),
+            reserved=growth, order=next(self._order),
+        )
+        if cow_src is not None:
+            # mid-block divergence: duplicate the agreeing block so our
+            # writes (suffix prefill + decode) never touch the shared
+            # original — the copy-on-write half of the design
+            idx = jnp.asarray([cow_src], jnp.int32)
+            self._cache = self._copy_jit(
+                self._cache, idx, jnp.asarray([fresh[0]], jnp.int32))
+            self.mgr.decref([cow_src])  # the pin; the copy is ours now
+            self.metrics.on_cow()
+        if self.prefix is not None:
+            self.metrics.on_prefix_lookup(P, start)
+        self._bt[slot, :len(seq.blocks)] = seq.blocks
+        self._bt[slot, len(seq.blocks):] = 0
+        self._bt_dev = None
+        with self.tracer.span("serve_prefill", step=self._tick_no) as sp:
+            first, finished = self._prefill_call(
+                req, slot, start=start, prompt=prompt, budget=budget)
+            sp.set(request=req.id, slot=slot, prompt_len=P,
+                   cached_tokens=start, bucket=self.bucket(P - start))
+        seq.n_filled = P
+        if self.prefix is not None:
+            self.prefix.insert(prompt, seq.blocks)
+        now = time.monotonic()
+        req.prefilled_at = now
+        resumed = req.first_token_at is not None
+        if not resumed:
+            req.first_token_at = now
+            self.metrics.on_first_token(req, now)
+        else:
+            gap_from = getattr(req, "_last_emit_at", None)
+            if gap_from is not None:
+                self.metrics.on_token_gap(now - gap_from)
+        req._last_emit_at = now
+        self.metrics.count_tokens(1)  # the prefill-sampled token
+        self._slots[slot] = req
+        self._seqs[slot] = seq
+        if finished:
+            self._free_slot(slot)
+        return TokenEvent(req, first, finished)
+
+    def _preempt(self, slot: int) -> None:
+        """Pool exhausted: push this slot's request back to the queue
+        HEAD (recompute preemption — generated tokens ride along and
+        re-prefill on re-admission, often from their own radix-cached
+        prefix). The degraded-but-alive alternative to a crash."""
+        req = self._slots[slot]
+        self._free_slot(slot)
+        self.metrics.on_preempt()
+        self.tracer.event("request_preempted", request=req.id,
+                          generated=len(req.tokens), tick=self._tick_no)
+        self.queue.push_front(req)
+
+    def _ensure_blocks(self) -> None:
+        """Before a tick, every live slot must own the block its next
+        write lands in. Allocate (evicting radix holds as needed);
+        when the pool is truly dry, preempt the YOUNGEST slot and
+        retry — oldest requests always progress, so the loop
+        terminates and nobody starves."""
+        for s in sorted(
+            (t for t in range(self.cfg.slots) if self._slots[t] is not None),
+            key=lambda t: self._seqs[t].order,
+        ):
+            while self._slots[s] is not None:
+                seq = self._seqs[s]
+                needed = seq.n_filled // self.cfg.block_size + 1
+                if len(seq.blocks) >= needed:
+                    break
+                got = self._alloc(1, seq)
+                if got is not None:
+                    self._bt[s, len(seq.blocks)] = got[0]
+                    seq.blocks.append(got[0])
+                    self._bt_dev = None
+                    continue
+                victim = max(
+                    (t for t in range(self.cfg.slots)
+                     if self._slots[t] is not None),
+                    key=lambda t: self._seqs[t].order,
+                )
+                self._preempt(victim)
 
     # ------------------------------------------------------------ events
 
@@ -347,14 +650,20 @@ class Engine:
 
     def step(self) -> list[TokenEvent]:
         """One scheduling round: admit from the queue into free slots
-        (prefill, budget-limited), advance all active slots one token,
-        route emissions. Returns this round's emissions."""
+        (block-gated, prefill, budget-limited), ensure every live slot
+        owns its next write block (preempting on exhaustion), advance
+        all active slots one token, route emissions."""
         emissions: list[TokenEvent] = []
         now = time.monotonic()
 
         free = [s for s, r in enumerate(self._slots) if r is None]
         if free:
-            admit, expired = self.queue.pop_ready(len(free), now)
+            admit, expired = self.queue.pop_ready(
+                len(free), now, can_admit=self._can_admit)
+            # pop_ready only expires requests it reaches; a block-gated
+            # head stops the walk, so sweep the remainder too — a
+            # deadline behind a stalled head must still fire on time
+            expired += self.queue.drop_expired(now)
         else:
             admit, expired = [], self.queue.drop_expired(now)
         for req in expired:
@@ -365,25 +674,25 @@ class Engine:
                             reason="deadline exceeded in queue")
             self._emit(ev)
             emissions.append(ev)
-        for req in admit:
+        while admit:
+            req = admit.pop(0)
             slot = free.pop(0)
-            with self.tracer.span("serve_prefill", step=self._tick_no) as sp:
-                first, finished = self._prefill_call(req, slot)
-                sp.set(request=req.id, slot=slot,
-                       prompt_len=req.prompt_len,
-                       bucket=self.bucket(req.prompt_len))
-            req.prefilled_at = req.first_token_at = time.monotonic()
-            req._last_emit_at = req.first_token_at
-            self.metrics.on_first_token(req, req.first_token_at)
-            self.metrics.count_tokens(1)  # the prefill-sampled token
-            ev = TokenEvent(req, first, finished)
+            ev = self._admit(req, slot)
+            if ev is None:
+                # allocation raced an eviction between gate and admit:
+                # requeue head-first in arrival order and retry next
+                # round — degraded, never dropped
+                for r in reversed([req] + admit):
+                    self.mgr.release(self._pending_reserve.pop(r.id, 0))
+                    self.queue.push_front(r)
+                break
             self._emit(ev)
             emissions.append(ev)
-            if finished:
+            if ev.finished:
                 self.metrics.on_finish(req)
-            else:
-                self._slots[slot] = req
 
+        if self.n_active:
+            self._ensure_blocks()
         if self.n_active:
             if self.chaos is not None:
                 self.chaos.on_tick(self._tick_no)
@@ -397,6 +706,7 @@ class Engine:
             for s, req in enumerate(self._slots):
                 if req is None:
                     continue
+                self._seqs[s].n_filled += 1
                 ev = TokenEvent(req, int(toks[s]), bool(fins[s]))
                 gap_from = getattr(req, "_last_emit_at", None)
                 if gap_from is not None:
@@ -407,7 +717,7 @@ class Engine:
                 emitted += 1
                 if ev.finished:
                     self.metrics.on_finish(req, tnow)
-                    self._slots[s] = None
+                    self._free_slot(s)
             self.metrics.on_tick(dur, emitted)
             self._tick_no += 1
             if self.cfg.snapshot_every \
@@ -416,6 +726,9 @@ class Engine:
 
         self.metrics.observe_state(
             len(self.queue), self.n_active, self.cfg.slots)
+        self.metrics.observe_cache(
+            self.mgr.in_use, self.mgr.num_free, self.n_active,
+            self._block_bytes)
         self.hb.beat(step=self._tick_no, phase="serve",
                      active=self.n_active, queue=len(self.queue))
         return emissions
@@ -433,8 +746,10 @@ class Engine:
         lifecycle events — `obs doctor` reads `serve_end` as the
         terminal record separating a drained server from a hung one."""
         drain_when = drain_when or (lambda: True)
-        self.tracer.event("serve_start", slots=self.cfg.slots,
-                          max_len=self.cfg.max_len)
+        self.tracer.event(
+            "serve_start", slots=self.cfg.slots, max_len=self.cfg.max_len,
+            block_size=self.cfg.block_size, num_blocks=self.cfg.num_blocks,
+            prefix_cache=self.cfg.prefix_cache)
         self.hb.pulse(phase="serve", step=self._tick_no)
         try:
             while True:
@@ -460,6 +775,8 @@ class Engine:
                 rejected=summary["rejected"],
                 timed_out=summary["timed_out"],
                 tokens=summary["tokens"],
+                prefix_hits=summary["prefix_hits"],
+                preempted=summary["preempted"],
             )
             self.hb.close(phase="done", tokens=summary["tokens"])
         return summary

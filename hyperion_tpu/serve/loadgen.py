@@ -36,6 +36,13 @@ class LoadSpec:
     temperature: float = 0.0
     seed: int = 0
     deadline_s: float | None = None
+    # > 0: every request's prompt starts with the SAME seeded
+    # shared_prefix_tokens-long prefix (a system prompt), and
+    # prompt_lens become the per-request TAIL lengths — the workload
+    # shape that makes the engine's radix prefix cache earn its keep
+    # (the first request prefills the prefix, every later one reuses
+    # its blocks). The bench `serving` probe runs this mode.
+    shared_prefix_tokens: int = 0
 
 
 def run_load(engine, spec: LoadSpec) -> dict:
@@ -50,10 +57,23 @@ def run_load(engine, spec: LoadSpec) -> dict:
     rng = np.random.default_rng(spec.seed)
     inter = rng.exponential(1.0 / spec.rate_hz, spec.n_requests)
     arrivals = np.cumsum(inter)
+    prefix = (rng.integers(1, spec.vocab, spec.shared_prefix_tokens)
+              if spec.shared_prefix_tokens else None)
+    if prefix is not None and hasattr(engine, "tracer"):
+        # stamp the workload shape on the stream: `obs doctor` uses
+        # this to call out a shared-prefix run whose hit counter
+        # stayed at zero (a mis-configured prefix cache, not a slow one)
+        engine.tracer.event("serve_workload",
+                            shared_prefix_tokens=int(spec.shared_prefix_tokens),
+                            n_requests=spec.n_requests)
+
+    def next_prompt() -> np.ndarray:
+        tail = rng.integers(1, spec.vocab, rng.choice(spec.prompt_lens))
+        return tail if prefix is None else np.concatenate([prefix, tail])
+
     reqs = [
         Request(
-            prompt_ids=rng.integers(
-                1, spec.vocab, rng.choice(spec.prompt_lens)),
+            prompt_ids=next_prompt(),
             max_new_tokens=int(rng.choice(spec.max_new)),
             temperature=spec.temperature,
             seed=int(rng.integers(0, 2**31 - 1)),
@@ -84,6 +104,7 @@ def run_load(engine, spec: LoadSpec) -> dict:
         engine.step()
     elapsed = time.monotonic() - t0
 
+    cache = engine.metrics.summary()
     done = [r for r in reqs if r.status == "done"]
     timed_out = sum(1 for r in reqs if r.status == "timed_out")
     ttft_ms = [
@@ -111,4 +132,12 @@ def run_load(engine, spec: LoadSpec) -> dict:
         "elapsed_s": round(elapsed, 3),
         "arrival_rate_hz": spec.rate_hz,
         "slots": engine.cfg.slots,
+        "shared_prefix_tokens": spec.shared_prefix_tokens,
+        # paged-cache pressure keys (engine metrics roll-up) — these
+        # ride the bench `serving` row so `obs diff` gates cache
+        # regressions exactly like throughput regressions
+        **{k: cache.get(k)
+           for k in ("prefix_hit_rate", "prefill_tokens_saved",
+                     "preempted", "cow_copies", "blocks_in_use",
+                     "hbm_per_req_mb")},
     }

@@ -36,11 +36,16 @@ class ServeMetrics:
         self._clock = clock
         self._t0 = clock()
         self._tokens = 0
+        self._prefix_lookups = 0
+        self._prefix_hits = 0
         # pre-create the lifecycle counters: a drained run that never
         # rejected anything should snapshot rejected=0, not omit the
         # key (absent evidence reads as "unknown" downstream)
         for name in ("serve_accepted", "serve_rejected",
-                     "serve_timed_out", "serve_completed", "serve_ticks"):
+                     "serve_timed_out", "serve_completed", "serve_ticks",
+                     "serve_prefix_lookups", "serve_prefix_hits",
+                     "serve_prefill_tokens_saved", "serve_preempted",
+                     "serve_cow_copies", "serve_blocks_evicted"):
             self.reg.counter(name)
 
     # -------------------------------------------------- admission edge
@@ -70,6 +75,46 @@ class ServeMetrics:
         self.reg.counter("serve_completed").inc()
         self.reg.histogram("e2e_ms").observe(
             (now - req.submitted_at) * 1e3)
+
+    # -------------------------------------------------- paged KV cache
+
+    def on_prefix_lookup(self, prompt_tokens: int, cached_tokens: int) -> None:
+        """One radix walk at admission: `cached_tokens` of the
+        `prompt_tokens`-long prompt came from shared blocks instead of
+        prefill compute. The hit-rate gauge is the fraction of lookups
+        that reused ANYTHING; tokens-saved is the prefill work that
+        never ran — the number that turns into TTFT under a shared
+        system prompt."""
+        self._prefix_lookups += 1
+        self.reg.counter("serve_prefix_lookups").inc()
+        if cached_tokens > 0:
+            self._prefix_hits += 1
+            self.reg.counter("serve_prefix_hits").inc()
+            self.reg.counter("serve_prefill_tokens_saved").inc(cached_tokens)
+        self.reg.gauge("serve_prefix_hit_rate").set(
+            self._prefix_hits / self._prefix_lookups)
+
+    def on_preempt(self) -> None:
+        self.reg.counter("serve_preempted").inc()
+
+    def on_cow(self) -> None:
+        self.reg.counter("serve_cow_copies").inc()
+
+    def on_evict(self, n: int) -> None:
+        self.reg.counter("serve_blocks_evicted").inc(n)
+
+    def observe_cache(self, blocks_in_use: int, blocks_free: int,
+                      active_reqs: int, block_bytes: int) -> None:
+        """Cache-pressure gauges, refreshed every step. blocks_in_use
+        near capacity with preemptions counting up = `--num-blocks`
+        undersized; hbm_per_req_mb is the honest per-request memory
+        cost AFTER sharing — the number the slab design could never
+        report below slots x max_len."""
+        self.reg.gauge("serve_blocks_in_use").set(blocks_in_use)
+        self.reg.gauge("serve_blocks_free").set(blocks_free)
+        if active_reqs:
+            self.reg.gauge("serve_hbm_per_req_mb").set(
+                blocks_in_use * block_bytes / active_reqs / 2**20)
 
     # ------------------------------------------------------- loop state
 
@@ -123,4 +168,15 @@ class ServeMetrics:
             "tpot_ms": h.get("tpot_ms", {"count": 0}),
             "e2e_ms": h.get("e2e_ms", {"count": 0}),
             "ticks": int(c.get("serve_ticks", 0)),
+            # paged-cache pressure (serve/blocks.py)
+            "prefix_lookups": int(c.get("serve_prefix_lookups", 0)),
+            "prefix_hits": int(c.get("serve_prefix_hits", 0)),
+            "prefix_hit_rate": g.get("serve_prefix_hit_rate", 0.0),
+            "prefill_tokens_saved": int(
+                c.get("serve_prefill_tokens_saved", 0)),
+            "preempted": int(c.get("serve_preempted", 0)),
+            "cow_copies": int(c.get("serve_cow_copies", 0)),
+            "blocks_evicted": int(c.get("serve_blocks_evicted", 0)),
+            "blocks_in_use": g.get("serve_blocks_in_use"),
+            "hbm_per_req_mb": g.get("serve_hbm_per_req_mb"),
         }

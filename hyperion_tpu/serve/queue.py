@@ -142,7 +142,8 @@ class AdmissionQueue:
     # ------------------------------------------------------------- pops
 
     def pop_ready(
-        self, n_slots: int, now: float | None = None
+        self, n_slots: int, now: float | None = None,
+        can_admit: Callable[[Request], bool] | None = None,
     ) -> tuple[list[Request], list[Request]]:
         """(admit, timed_out) for one scheduling round.
 
@@ -151,7 +152,15 @@ class AdmissionQueue:
         request whose prompt alone exceeds the budget is admitted when
         nothing else has been this round (otherwise it would starve
         forever). Expired requests are dropped here, at the last moment
-        before their prefill would be paid."""
+        before their prefill would be paid.
+
+        `can_admit` is the engine's block-availability gate (paged KV
+        cache): a head whose worst-case block demand does not fit stays
+        queued — and blocks everything behind it, deliberately, because
+        skipping ahead would starve large requests exactly the way the
+        prefill budget refuses to. It is consulted last, immediately
+        before the pop, so a True return (which reserves blocks) always
+        corresponds to a popped request."""
         now = time.monotonic() if now is None else now
         admit: list[Request] = []
         expired: list[Request] = []
@@ -167,6 +176,8 @@ class AdmissionQueue:
                     continue
                 if head.prompt_len > budget and admit:
                     break  # next round gets a fresh budget for it
+                if can_admit is not None and not can_admit(head):
+                    break  # pool pressure: wait for blocks to free up
                 self._q.popleft()
                 head.status = "active"
                 admit.append(head)
@@ -174,6 +185,15 @@ class AdmissionQueue:
                 if budget <= 0:
                     break
         return admit, expired
+
+    def push_front(self, req: Request) -> None:
+        """Re-queue at the HEAD, bypassing capacity: used for preempted
+        (or allocation-raced) requests that were already admitted once —
+        they resume first, so preemption degrades latency, never
+        fairness."""
+        req.status = "queued"
+        with self._lock:
+            self._q.appendleft(req)
 
     def drop_expired(self, now: float | None = None) -> list[Request]:
         """Sweep expired requests without admitting (used while all

@@ -241,6 +241,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slots", type=int, default=4,
                    help="concurrent requests decoded per tick (the "
                         "static batch dimension)")
+    p.add_argument("--block-size", type=int, default=16,
+                   help="tokens per KV-cache block (serve/blocks.py): "
+                        "smaller = finer memory granularity and more "
+                        "prefix-sharing opportunities, larger = smaller "
+                        "block tables; need not divide max_len (the "
+                        "table rounds up to whole blocks)")
+    p.add_argument("--num-blocks", type=int, default=0,
+                   help="KV pool size in blocks incl. the null block "
+                        "(0 = auto: slots x ceil(max_len/block_size) + 1, "
+                        "the static-slab equivalent); smaller values "
+                        "oversubscribe HBM and lean on prefix sharing + "
+                        "preemption")
+    p.add_argument("--prefix-cache", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="radix prefix reuse: prompts sharing a prefix "
+                        "with an earlier request skip its prefill and "
+                        "share the cached blocks (--no-prefix-cache to "
+                        "disable)")
     p.add_argument("--queue-capacity", type=int, default=64,
                    help="admission queue bound; beyond it requests are "
                         "rejected with reason queue_full")
@@ -315,6 +333,8 @@ def main(argv=None) -> int:
             slots=args.slots, max_len=args.max_len, eos_id=eos_id,
             queue_capacity=args.queue_capacity,
             prefill_budget=args.prefill_budget,
+            block_size=args.block_size, num_blocks=args.num_blocks,
+            prefix_cache=args.prefix_cache,
         ),
         tracer=tracer, heartbeat=hb, chaos=chaos,
     )

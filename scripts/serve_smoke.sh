@@ -63,3 +63,37 @@ tokens = sum(1 for r in lines if r.get("event") == "token")
 print(f"[serve_smoke] OK: 3 requests done, {tokens} tokens streamed, "
       "clean drain")
 PY
+
+# 4. shared-prefix round trip: two prompt_ids requests with a common
+#    12-token prefix through a small-block paged cache, telemetry on —
+#    the second request must HIT the radix prefix cache (counter > 0
+#    on the stream), proving the paged reuse path end to end
+printf '%s\n' \
+  '{"id":"p1","prompt_ids":[3,4,5,6,7,8,9,10,11,12,13,14,20,21],"max_new_tokens":4}' \
+  '{"id":"p2","prompt_ids":[3,4,5,6,7,8,9,10,11,12,13,14,30,31],"max_new_tokens":4}' \
+  | env HYPERION_TELEMETRY="$WORK/tele.jsonl" \
+    python -m hyperion_tpu.cli.main serve \
+      --ckpt "$WORK/llama.npz" --tokenizer-dir "$WORK/tok" \
+      --max-len 64 --slots 2 --warmup-lens 8 --block-size 4 \
+      --prefix-cache \
+      > "$WORK/prefix_responses.jsonl"
+
+python - "$WORK/prefix_responses.jsonl" "$WORK/tele.jsonl" <<'PY'
+import json
+import sys
+
+lines = [json.loads(line) for line in open(sys.argv[1])]
+dones = {r["id"] for r in lines if r.get("event") == "done"}
+assert dones == {"p1", "p2"}, f"expected p1/p2 done, got {dones}"
+hits = saved = 0
+for line in open(sys.argv[2]):
+    rec = json.loads(line)
+    if rec.get("kind") == "snapshot":
+        c = rec.get("metrics", {}).get("counters", {})
+        hits = max(hits, c.get("serve_prefix_hits", 0))
+        saved = max(saved, c.get("serve_prefill_tokens_saved", 0))
+assert hits >= 1, f"shared-prefix request never hit the prefix cache"
+assert saved > 0, "prefix hit saved zero prefill tokens"
+print(f"[serve_smoke] OK: prefix round trip — {hits} hit(s), "
+      f"{saved} prefill tokens saved")
+PY

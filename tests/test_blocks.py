@@ -1,0 +1,255 @@
+"""Paged KV-cache bookkeeping (serve/blocks.py): property-style
+random-operation soak over the block manager + radix prefix cache,
+plus targeted pins for the invariants the engine's correctness rides
+on — no leaks, no double frees, refcounts that return the pool to its
+initial free count, and copy-on-write forks that never alias a
+writer's tail block.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from hyperion_tpu.serve.blocks import (
+    NULL_BLOCK,
+    BlockError,
+    BlockManager,
+    RadixPrefixCache,
+    SeqAlloc,
+    blocks_for,
+    fork_alloc,
+)
+
+
+class TestBlockManager:
+    def test_alloc_is_all_or_nothing_and_deterministic(self):
+        mgr = BlockManager(6, 4)
+        assert mgr.capacity == 5
+        a = mgr.alloc(3)
+        assert a == [1, 2, 3]  # ascending, null block never handed out
+        assert NULL_BLOCK not in a
+        assert mgr.alloc(3) is None       # only 2 left: nothing granted
+        assert mgr.num_free == 2          # ...and nothing leaked
+        b = mgr.alloc(2)
+        assert b == [4, 5]
+        mgr.decref(a + b)
+        assert mgr.num_free == mgr.capacity
+
+    def test_double_free_and_bad_incref_raise(self):
+        mgr = BlockManager(4, 4)
+        (blk,) = mgr.alloc(1)
+        mgr.decref([blk])
+        with pytest.raises(BlockError):
+            mgr.decref([blk])
+        with pytest.raises(BlockError):
+            mgr.incref([blk])
+
+    def test_refcounts_gate_the_free_list(self):
+        mgr = BlockManager(4, 4)
+        (blk,) = mgr.alloc(1)
+        mgr.incref([blk])                 # a second holder
+        mgr.decref([blk])
+        assert mgr.num_free == 2          # still held
+        mgr.decref([blk])
+        assert mgr.num_free == 3          # last holder frees
+
+    def test_reservations_track_promises(self):
+        mgr = BlockManager(8, 4)
+        mgr.reserve(5)
+        assert mgr.reserved == 5
+        mgr.release(2)
+        mgr.release(9)                    # over-release clamps at zero
+        assert mgr.reserved == 0
+
+
+class TestForkCow:
+    def test_forked_then_diverged_never_aliases_writers_tail(self):
+        """The COW acceptance property: after a fork at a mid-block
+        frontier, the writer's tail block and the fork's tail block
+        are different physical blocks, while full blocks stay shared."""
+        mgr = BlockManager(16, 4)
+        seq = SeqAlloc(blocks=mgr.alloc(3))   # covers up to 12 positions
+        seq.n_filled = 10                     # mid-block frontier
+        fork, copies = fork_alloc(mgr, seq, seq.n_filled)
+        assert fork.blocks[:2] == seq.blocks[:2]       # full blocks shared
+        assert fork.blocks[2] != seq.blocks[2]         # tail copied
+        assert copies == [(seq.blocks[2], fork.blocks[2])]
+        # both "write" (append) independently: their tails stay disjoint
+        assert set(fork.blocks[2:]).isdisjoint(seq.blocks[2:])
+        for b in seq.blocks[:2]:
+            assert mgr.refcount(b) == 2
+        mgr.decref(seq.blocks)
+        mgr.decref(fork.blocks)
+        assert mgr.num_free == mgr.capacity
+
+    def test_block_aligned_fork_copies_nothing(self):
+        mgr = BlockManager(16, 4)
+        seq = SeqAlloc(blocks=mgr.alloc(2))
+        fork, copies = fork_alloc(mgr, seq, 8)  # frontier on the boundary
+        assert copies == [] and fork.blocks == seq.blocks
+        mgr.decref(seq.blocks)
+        mgr.decref(fork.blocks)
+        assert mgr.num_free == mgr.capacity
+
+    def test_fork_fails_clean_when_pool_dry(self):
+        mgr = BlockManager(3, 4)
+        seq = SeqAlloc(blocks=mgr.alloc(2))
+        fork, copies = fork_alloc(mgr, seq, 6)  # needs a tail copy: no room
+        assert fork is None and copies == []
+        assert mgr.num_free == 0 and mgr.refcount(seq.blocks[0]) == 1
+
+
+class TestRadixPrefixCache:
+    def _toks(self, seed, n):
+        return np.random.default_rng(seed).integers(1, 200, n)
+
+    def test_full_block_match_and_cap(self):
+        mgr = BlockManager(32, 4)
+        trie = RadixPrefixCache(mgr)
+        toks = self._toks(0, 12)
+        seq = mgr.alloc(3)
+        trie.insert(toks, seq)
+        # identical prompt, capped at len-1: the last full chunk cannot
+        # fully match (12 > 11), but the COW extension still reuses 3
+        # of its 4 tokens via one block copy — 11 of 12 positions cached
+        m = trie.lookup(toks, len(toks) - 1)
+        assert m.blocks == seq[:2] and m.tokens == 11 and m.cow_src == seq[2]
+        # an unrelated prompt matches nothing
+        none = trie.lookup(self._toks(99, 12), 11)
+        assert none.blocks == [] and none.tokens == 0 and none.cow_src is None
+
+    def test_mid_block_divergence_yields_cow(self):
+        mgr = BlockManager(32, 4)
+        trie = RadixPrefixCache(mgr)
+        toks = self._toks(1, 12)
+        seq = mgr.alloc(3)
+        trie.insert(toks, seq)
+        other = np.concatenate([toks[:10], [199, 198, 197, 196]])
+        m = trie.lookup(other, len(other) - 1)
+        assert m.blocks == seq[:2]
+        assert m.tokens == 10          # 8 full + 2 via COW extension
+        assert m.cow_src == seq[2]
+
+    def test_eviction_is_lru_and_refcount_gated(self):
+        mgr = BlockManager(32, 4)
+        trie = RadixPrefixCache(mgr)
+        a, b = self._toks(2, 8), self._toks(3, 8)
+        sa, sb = mgr.alloc(2), mgr.alloc(2)
+        trie.insert(a, sa)
+        trie.insert(b, sb)
+        mgr.decref(sa + sb)            # sequences done: trie-only holds
+        trie.lookup(a, 8)              # touch a — b becomes LRU
+        free0 = mgr.num_free
+        assert trie.evict(2) == 2      # frees b's chain, leaves a's
+        assert mgr.num_free == free0 + 2
+        assert trie.lookup(a, 8).blocks == sa
+        assert trie.lookup(b, 8).blocks == []
+
+    def test_shared_chain_is_not_evictable(self):
+        mgr = BlockManager(32, 4)
+        trie = RadixPrefixCache(mgr)
+        toks = self._toks(4, 8)
+        seq = mgr.alloc(2)
+        trie.insert(toks, seq)         # seq still holds its refs
+        assert trie.evictable() == 0
+        assert trie.evict(2) == 0
+        mgr.decref(seq)
+        assert trie.evictable() == 2
+
+
+class TestRandomOpSoak:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_alloc_fork_free_never_leaks(self, seed):
+        """The property-style acceptance test: a random interleaving of
+        admit (alloc + trie share), append (grow), fork (COW), free,
+        and evict keeps every invariant, and tearing everything down
+        returns the pool to its initial free count."""
+        rng = np.random.default_rng(seed)
+        bs = 4
+        mgr = BlockManager(48, bs)
+        trie = RadixPrefixCache(mgr)
+        live: list[dict] = []          # {"seq", "toks"}
+        corpus = [rng.integers(1, 50, int(rng.integers(2, 20)))
+                  for _ in range(6)]
+
+        def admit():
+            base = corpus[rng.integers(0, len(corpus))]
+            toks = np.concatenate(
+                [base, rng.integers(1, 50, int(rng.integers(0, 6)))])
+            P = len(toks)
+            m = trie.lookup(toks, P - 1)
+            pin = list(m.blocks) + (
+                [m.cow_src] if m.cow_src is not None else [])
+            mgr.incref(pin)
+            need = blocks_for(P, bs) - len(m.blocks)
+            fresh = mgr.alloc(need)
+            if fresh is None and trie.evict(need - mgr.num_free):
+                fresh = mgr.alloc(need)
+            if fresh is None:
+                mgr.decref(pin)
+                return
+            if m.cow_src is not None:
+                mgr.decref([m.cow_src])
+            seq = SeqAlloc(blocks=list(m.blocks) + fresh,
+                           n_shared=len(m.blocks), n_filled=P)
+            trie.insert(toks, seq.blocks)
+            live.append({"seq": seq, "toks": toks})
+
+        def append():
+            if not live:
+                return
+            entry = live[rng.integers(0, len(live))]
+            seq = entry["seq"]
+            seq.n_filled += 1
+            if seq.n_filled // bs + 1 > len(seq.blocks):
+                got = mgr.alloc(1)
+                if got is None and trie.evict(1):
+                    got = mgr.alloc(1)
+                if got is None:
+                    seq.n_filled -= 1
+                    return
+                seq.blocks.extend(got)
+
+        def fork():
+            if not live:
+                return
+            entry = live[rng.integers(0, len(live))]
+            seq = entry["seq"]
+            f, copies = fork_alloc(mgr, seq, seq.n_filled)
+            if f is None:
+                return
+            f.n_filled = seq.n_filled
+            # diverge both: neither may ever touch the other's tail
+            if copies:
+                assert copies[0][1] != copies[0][0]
+                assert f.blocks[-1] != seq.blocks[-1]
+            live.append({"seq": f,
+                         "toks": entry["toks"][:seq.n_filled]})
+
+        def free():
+            if not live:
+                return
+            entry = live.pop(rng.integers(0, len(live)))
+            mgr.decref(entry["seq"].blocks)
+
+        ops = [admit, append, append, fork, free]
+        for _ in range(300):
+            ops[rng.integers(0, len(ops))]()
+            mgr.check()                # free/used partition + refcounts
+            # no two live sequences share a TAIL (write-frontier) block
+            tails = [e["seq"].blocks[-1] for e in live
+                     if e["seq"].blocks
+                     and e["seq"].n_filled % bs != 0]
+            # a tail may be shared right after a block-aligned fork;
+            # only mid-block frontiers are writers
+            writers = [t for t in tails]
+            assert len(writers) == len(set(writers)), (
+                "two writers alias one tail block")
+
+        while live:
+            free()
+        trie.clear()
+        mgr.check()
+        assert mgr.num_free == mgr.capacity, "pool leaked blocks"
+        assert mgr.reserved == 0
