@@ -463,7 +463,12 @@ def _add_serving(out: dict, hb, tracer, remaining) -> None:
     out["serving"] = srv if srv is not None else {"error": serr}
     tracer.event("serving", ok=srv is not None, error=serr or None,
                  tokens_per_s=(srv or {}).get("tokens_per_s"),
-                 reject_rate=(srv or {}).get("reject_rate"))
+                 reject_rate=(srv or {}).get("reject_rate"),
+                 # where the p99 went (loadgen attribution keys) — so a
+                 # round-over-round trace shows the tail MOVING between
+                 # phases, not just growing
+                 dominant_phase_p99=(srv or {}).get("dominant_phase_p99"),
+                 ttft_p99_ms=(srv or {}).get("ttft_p99_ms"))
 
 
 def main() -> None:

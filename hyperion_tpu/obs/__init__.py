@@ -36,6 +36,13 @@ Consumer/health half (PR 2 — the stream diagnosing its own runs):
   * `diff`      — `obs diff <a> <b>`: percent-delta comparison of two
                   run summaries with a regression threshold, plus
                   `--history` trajectory tables over e.g. BENCH_r*.json.
+  * `timeline`  — `obs trace <dir>`: per-request waterfalls
+                  reconstructed from the serve path's lifecycle events,
+                  Chrome trace-event/Perfetto export, worst-k exemplar
+                  requests, and tail-latency attribution (TTFT/e2e at
+                  p50/p99 decomposed into queue / block-gate / prefill /
+                  decode / preempt-replay / client-write); the doctor's
+                  named serving incidents come from the same math.
 
 Reaction half (PR 3 — `train/supervisor.py` + `checkpoint/integrity.py`):
 the doctor's verdicts drive a restart supervisor (crashed/hung ->

@@ -58,6 +58,16 @@ METRICS: dict[str, str] = {
     "serve_prefix_hit_rate": "higher",
     "serve_blocks_in_use": "lower",
     "serve_hbm_per_req_mb": "lower",
+    # per-phase tail attribution (obs/timeline.py via the bench serving
+    # row): gating the COMPONENTS catches a tail that merely moved —
+    # e.g. queue wait doubling while prefill halves leaves ttft_p99
+    # flat and would sail through the aggregate gate
+    "serve_queue_wait_p99_ms": "lower",
+    "serve_gate_wait_p99_ms": "lower",
+    "serve_prefill_p99_ms": "lower",
+    "serve_decode_p99_ms": "lower",
+    "serve_preempt_replay_p99_ms": "lower",
+    "serve_client_write_p99_ms": "lower",
 }
 
 
@@ -117,7 +127,17 @@ def normalize(doc: dict) -> dict[str, float]:
                               ("reject_rate", "serve_reject_rate"),
                               ("prefix_hit_rate", "serve_prefix_hit_rate"),
                               ("blocks_in_use", "serve_blocks_in_use"),
-                              ("hbm_per_req_mb", "serve_hbm_per_req_mb")):
+                              ("hbm_per_req_mb", "serve_hbm_per_req_mb"),
+                              ("queue_wait_p99_ms",
+                               "serve_queue_wait_p99_ms"),
+                              ("gate_wait_p99_ms",
+                               "serve_gate_wait_p99_ms"),
+                              ("prefill_p99_ms", "serve_prefill_p99_ms"),
+                              ("decode_p99_ms", "serve_decode_p99_ms"),
+                              ("preempt_replay_p99_ms",
+                               "serve_preempt_replay_p99_ms"),
+                              ("client_write_p99_ms",
+                               "serve_client_write_p99_ms")):
                 v = _num(srv.get(src))
                 if v is not None:
                     out[name] = v

@@ -62,6 +62,10 @@ _STALL_MIN_STEPS = 6     # need a baseline before "slower than usual" means anyt
 # data-starved — the fix is prefetch depth / faster input, not a
 # bigger chip
 INPUT_BOUND_FRAC = 0.5
+# tail-attribution threshold: a phase owning at least this fraction of
+# the p99 cohort's latency (obs/timeline.py) earns a NAMED incident —
+# below it, the tail is diffuse and naming one phase would mislead
+TAIL_DOMINANT_FRAC = 0.4
 
 
 def locate(target: str | Path) -> tuple[Path, Path]:
@@ -334,6 +338,52 @@ def diagnose(
                                       "failed"):
         reason += "; cache pressure: " + "; ".join(cache_pressure)
 
+    # Tail-attribution incidents (obs/timeline.py): the request-scoped
+    # trace says WHERE the p99 went, so the doctor can name the FIX —
+    # "raise --slots" and "raise --num-blocks" are different knobs a
+    # bare p99 number cannot choose between.
+    tail_rows: list[dict] = []
+    tail_incidents: list[str] = []
+    tail_incident_metrics: list[str] = []
+    if any(e.get("name") == "request_finished" for e in events):
+        from hyperion_tpu.obs import timeline
+
+        att = timeline.attribution(timeline.requests_from_records(
+            recs, run=run))
+        tail_rows = att["rows"]
+        for row in tail_rows:
+            if row["q"] != 99 or not row.get("dominant"):
+                continue
+            if (row.get("dominant_frac") or 0.0) < TAIL_DOMINANT_FRAC:
+                continue
+            dom = row["dominant"]
+            where = (f"{row['components_ms'].get(dom, row['other_ms'])}"
+                     f" of {row['value_ms']} ms")
+            msg = None
+            if row["metric"] == "ttft" and dom == "queue_wait":
+                msg = (f"p99 TTFT dominated by queue wait ({where}) — "
+                       "raise --slots or tighten admission")
+            elif dom == "gate_wait":
+                msg = (f"p99 {row['metric']} dominated by block-gate "
+                       f"wait ({where}) — raise --num-blocks")
+            elif row["metric"] == "e2e" and dom == "preempt_replay":
+                msg = (f"p99 e2e dominated by preempt replay ({where}) "
+                       "— --num-blocks undersized for this load")
+            elif row["metric"] == "e2e" and dom == "client_write":
+                msg = (f"p99 e2e dominated by client writes ({where}) "
+                       "— slow consumer, not a slow engine")
+            if msg is not None:
+                tail_incidents.append(msg)
+                # the metric rides structurally next to the message so
+                # the renderer can flag the RIGHT attribution row
+                # without parsing incident prose
+                tail_incident_metrics.append(row["metric"])
+        tail_incidents = list(dict.fromkeys(tail_incidents))
+        tail_incident_metrics = list(dict.fromkeys(tail_incident_metrics))
+    if tail_incidents and verdict in ("healthy", "running", "stalled",
+                                      "failed"):
+        reason += "; tail attribution: " + "; ".join(tail_incidents)
+
     last_span = spans[-1] if spans else None
     return {
         "target": str(target),
@@ -370,10 +420,17 @@ def diagnose(
         "hbm_peak_mb": hbm_peak,
         "serve": serve,
         "cache_pressure": cache_pressure,
+        "tail_attribution": tail_rows,
+        "tail_incidents": tail_incidents,
+        "tail_incident_metrics": tail_incident_metrics,
         "heartbeat": {
             "phase": hb.get("phase"), "step": hb.get("step"),
             "pid": hb.get("pid"), "beats": hb.get("beats"),
             "age_s": round(hb_age, 1) if hb_age is not None else None,
+            # serve-loop payload (engine beats): occupancy at the last
+            # beat — the hung-vs-slow call needs to know whether the
+            # loop froze with work in hand
+            "active": hb.get("active"), "queue": hb.get("queue"),
         } if hb else None,
     }
 
@@ -471,12 +528,27 @@ def render_markdown(d: dict) -> str:
                 f"{_fmt(srv.get('prefix_hit_rate'))}, preempted "
                 f"{_fmt(srv.get('preempted'))}, HBM/req "
                 f"{_fmt(srv.get('hbm_per_req_mb'))} MB{flag} |")
+    for row in d.get("tail_attribution") or []:
+        comps = ", ".join(f"{p} {v:.1f}"
+                          for p, v in row["components_ms"].items() if v)
+        flag = (" — **incident**"
+                if row["q"] == 99 and row["metric"] in
+                (d.get("tail_incident_metrics") or ()) else "")
+        lines.append(
+            f"| {row['metric']} p{row['q']} attribution | "
+            f"{row['value_ms']:.1f} ms = {comps}, other "
+            f"{row['other_ms']:.1f} (dominant: {row['dominant']})"
+            f"{flag} |")
     hb = d.get("heartbeat")
     if hb:
+        occ = ""
+        if hb.get("active") is not None or hb.get("queue") is not None:
+            occ = (f", active {_fmt(hb.get('active'))}, "
+                   f"queue {_fmt(hb.get('queue'))}")
         lines.append(
             f"| heartbeat | phase {hb['phase']!r}, step {_fmt(hb['step'])}, "
             f"pid {hb['pid']}, {hb['beats']} beats, "
-            f"age {_fmt(hb['age_s'])} s |"
+            f"age {_fmt(hb['age_s'])} s{occ} |"
         )
     else:
         lines.append("| heartbeat | none for this run |")

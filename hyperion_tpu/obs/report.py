@@ -199,10 +199,15 @@ def main(argv=None) -> int:
         from hyperion_tpu.obs.diff import main as diff_main
 
         return diff_main(argv[1:])
+    if argv and argv[0] == "trace":
+        from hyperion_tpu.obs.timeline import main as trace_main
+
+        return trace_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="hyperion obs",
         description="telemetry stream tools (obs/report.py); see also "
-                    "`obs doctor <dir>` and `obs diff <a> <b>`",
+                    "`obs doctor <dir>`, `obs diff <a> <b>`, and "
+                    "`obs trace <dir>`",
     )
     sub = p.add_subparsers(dest="cmd", required=True)
     sub.add_parser("doctor", help="classify a run (healthy/crashed/hung/"
@@ -210,6 +215,9 @@ def main(argv=None) -> int:
                                   "heartbeat")
     sub.add_parser("diff", help="compare two run summaries with a "
                                 "regression threshold")
+    sub.add_parser("trace", help="per-request waterfalls, Chrome trace "
+                                 "export, and tail-latency attribution "
+                                 "for a serve run")
     s = sub.add_parser("summarize", help="render a run summary from a "
                                          "telemetry JSONL")
     s.add_argument("telemetry", help="path to telemetry.jsonl")

@@ -11,9 +11,13 @@ admission queue with backpressure, deadlines, a prefill budget, and a
 block-availability gate (`queue`), serving SLO + cache-pressure gauges
 on the obs registry (`metrics`), a JSONL stdin/socket front-end +
 client (`server`, `client`), and a deterministic Poisson load driver
-with a shared-prefix workload mode (`loadgen`). `SERVING.md` documents
-the paged design and why recompile-free refill is the whole game on
-TPU.
+with a shared-prefix workload mode (`loadgen`). Every request streams
+its lifecycle (admitted → scheduled → prefill → first token →
+finished, with per-phase wait/compute/transport totals) onto the obs
+telemetry stream; `obs trace` (`obs/timeline.py`) turns that into
+waterfalls, Chrome trace exports, and tail-latency attribution.
+`SERVING.md` documents the paged design, why recompile-free refill is
+the whole game on TPU, and the tracing event vocabulary.
 """
 
 from hyperion_tpu.serve.blocks import (  # noqa: F401

@@ -288,6 +288,11 @@ class Engine:
         self._cache = init_paged_cache(mcfg, num_blocks, bs)
         self._state = self._init_state()
         self._tick_no = 0
+        # cumulative transport-sink seconds (all requests); per-request
+        # marks against this counter net decode gaps of EVERY sink
+        # write in the window, not just the request's own — a slow
+        # neighbour's client must not read as this slot's decode time
+        self._sink_s = 0.0
         self._tick_jit, self._prefill_jit, self._copy_jit = _shared_jits(
             donate=jax.default_backend() != "cpu")
 
@@ -533,25 +538,38 @@ class Engine:
         self._bt[slot, :len(seq.blocks)] = seq.blocks
         self._bt[slot, len(seq.blocks):] = 0
         self._bt_dev = None
+        resumed = req.first_token_at is not None
         with self.tracer.span("serve_prefill", step=self._tick_no) as sp:
             first, finished = self._prefill_call(
                 req, slot, start=start, prompt=prompt, budget=budget)
             sp.set(request=req.id, slot=slot, prompt_len=P,
-                   cached_tokens=start, bucket=self.bucket(P - start))
+                   cached_tokens=start, bucket=self.bucket(P - start),
+                   resumed=resumed)
         seq.n_filled = P
         if self.prefix is not None:
             self.prefix.insert(prompt, seq.blocks)
         now = time.monotonic()
         req.prefilled_at = now
-        resumed = req.first_token_at is not None
+        if resumed:
+            # a resume re-prefills prompt + generated: pure replay cost
+            req.replay_s += sp.dur_s or 0.0
+        else:
+            req.prefill_s += sp.dur_s or 0.0
         if not resumed:
             req.first_token_at = now
             self.metrics.on_first_token(req, now)
+            self.tracer.event(
+                "request_first_token", request=req.id, tick=self._tick_no,
+                ttft_s=round(now - req.submitted_at, 6),
+                queue_wait_s=round(req.queue_wait_s, 6),
+                gate_wait_s=round(req.gate_wait_s, 6),
+                prefill_s=round(req.prefill_s, 6))
         else:
             gap_from = getattr(req, "_last_emit_at", None)
             if gap_from is not None:
                 self.metrics.on_token_gap(now - gap_from)
         req._last_emit_at = now
+        req._sink_mark = self._sink_s
         self.metrics.count_tokens(1)  # the prefill-sampled token
         self._slots[slot] = req
         self._seqs[slot] = seq
@@ -567,9 +585,42 @@ class Engine:
         req = self._slots[slot]
         self._free_slot(slot)
         self.metrics.on_preempt()
+        req.preempts += 1
+        req._preempted = True  # its next queue wait is replay, not FIFO
         self.tracer.event("request_preempted", request=req.id,
                           generated=len(req.tokens), tick=self._tick_no)
         self.queue.push_front(req)
+
+    def _account_pop(self, req) -> bool:
+        """Bank the queue wait that ended at this pop into its
+        attribution bucket: replay wait when the pop resumes a
+        preemption, otherwise FIFO wait with the block-gated tail
+        (stamped by `pop_ready` at the first denial) broken out.
+        Returns whether this pop was a preemption resume, so a caller
+        that requeues the request (allocation race) can restore the
+        flag — the request is STILL a resume and its next wait must
+        bank as replay, not FIFO queue_wait."""
+        popped = (req.admitted_at if req.admitted_at is not None
+                  else time.monotonic())
+        wait = max(0.0, popped - req.enqueued_at)
+        gate = 0.0
+        if req.gate_blocked_at is not None:
+            gate = min(wait, max(0.0, popped - req.gate_blocked_at))
+            req.gate_blocked_at = None
+        resumed = req._preempted
+        if resumed:
+            req._preempted = False
+            req.replay_s += wait
+        else:
+            req.gate_wait_s += gate
+            req.queue_wait_s += wait - gate
+        self.tracer.event(
+            "request_scheduled", request=req.id, tick=self._tick_no,
+            resumed=resumed,
+            queue_wait_s=round(0.0 if resumed else wait - gate, 6),
+            gate_wait_s=round(0.0 if resumed else gate, 6),
+            replay_wait_s=round(wait if resumed else 0.0, 6))
+        return resumed
 
     def _ensure_blocks(self) -> None:
         """Before a tick, every live slot must own the block its next
@@ -605,13 +656,12 @@ class Engine:
         req = ev.request
         if ev.kind == "token" and ev.token is not None:
             req.tokens.append(ev.token)
-        if ev.finished or ev.kind != "token":
-            req.finished_at = time.monotonic()
-            if ev.kind == "token":
-                req.status = "done"
+        if ev.finished and ev.kind == "token":
+            req.status = "done"
         if self.chaos is not None:
             self.chaos.on_client(self._tick_no)
         if req.sink is not None:
+            t0 = time.monotonic()
             try:
                 req.sink(ev)
             except Exception:  # noqa: BLE001
@@ -619,10 +669,56 @@ class Engine:
                 # never the engine: drop the sink, let the slot finish
                 # out its budget (eos/budget latch frees it)
                 req.sink = None
+            # charge transport time to the REQUEST (a slow client must
+            # show up in its own tail attribution, not vanish into the
+            # decode gap it inflates)
+            dt = time.monotonic() - t0
+            req.client_write_s += dt
+            if ev.kind in ("token", "timed_out"):
+                # token AND timeout emissions happen only on the engine
+                # thread inside step(), so this read-modify-write is
+                # serial with the decode-gap netting that reads it, and
+                # both block live slots' gaps (a dead client stalling a
+                # timeout write must not read as decode). Reject writes
+                # run on front-end reader threads in parallel with
+                # ticks and must NOT pollute the counter
+                self._sink_s += dt
+            self.metrics.on_client_write(dt)
         if self.on_event is not None:
             self.on_event(ev)
         if ev.finished or ev.kind != "token":
+            # stamped AFTER the sink write, the same clock edge
+            # `_on_finished` uses for e2e: the final token's delivery is
+            # part of the request's life, or a slow client's last write
+            # would be charged to client_write yet fall outside e2e and
+            # the phases could sum past the total — and every reporter
+            # (request_finished event, loadgen e2e) reads this one stamp
+            req.finished_at = time.monotonic()
             req.done.set()
+
+    def _on_finished(self, req) -> None:
+        """Terminal accounting for a completed request: SLO metrics,
+        phase histograms, and the `request_finished` event whose
+        per-phase totals are what `obs trace` decomposes tails with.
+        e2e ends at `finished_at`, which `_emit` stamps after the final
+        sink write — the single terminal clock edge every reporter
+        (this event, the histograms, loadgen) agrees on."""
+        now = req.finished_at if req.finished_at is not None \
+            else time.monotonic()
+        self.metrics.on_finish(req, now)
+        reason = ("eos" if self.cfg.eos_id is not None and req.tokens
+                  and req.tokens[-1] == self.cfg.eos_id else "budget")
+        req.finish_reason = reason
+        self.metrics.on_phases(req)
+        self.tracer.event(
+            "request_finished", request=req.id, tick=self._tick_no,
+            reason=reason, prompt_len=req.prompt_len,
+            n_tokens=len(req.tokens), preempts=req.preempts,
+            e2e_s=round(now - req.submitted_at, 6),
+            ttft_s=(round(req.first_token_at - req.submitted_at, 6)
+                    if req.first_token_at is not None else None),
+            **{f"{p}_s": round(v, 6) for p, v in req.phases_s().items()},
+        )
 
     # -------------------------------------------------------- public api
 
@@ -632,10 +728,19 @@ class Engine:
         ok, reason = self.queue.submit(req)
         if ok:
             self.metrics.on_accept()
+            self.tracer.event("request_admitted", request=req.id,
+                              prompt_len=req.prompt_len,
+                              max_new_tokens=req.max_new_tokens,
+                              deadline_s=req.deadline_s)
         else:
+            # queued_s: rejection happens at the door, so the request
+            # spent zero time queued — the key exists so rejects land in
+            # the same attribution tables as everything else
+            req.finish_reason = "rejected"
             self.metrics.on_reject(reason)
             self.tracer.event("request_rejected", request=req.id,
-                              reason=reason, prompt_len=req.prompt_len)
+                              reason=reason, prompt_len=req.prompt_len,
+                              queued_s=0.0)
             self._emit(TokenEvent(req, None, True, kind="rejected",
                                   reason=reason))
         return ok, reason
@@ -668,8 +773,14 @@ class Engine:
             admit, expired = [], self.queue.drop_expired(now)
         for req in expired:
             self.metrics.on_timeout()
+            req.finish_reason = "timed_out"
+            # enqueued_at, not submitted_at: a preempted-then-requeued
+            # request that expires spent part of its life in a slot,
+            # and that time is replay cost, not queue residency
+            queued = round(max(0.0, now - req.enqueued_at), 6)
             self.tracer.event("request_timeout", request=req.id,
-                              waited_s=round(now - req.submitted_at, 3))
+                              waited_s=round(now - req.submitted_at, 3),
+                              queued_s=queued)
             ev = TokenEvent(req, None, True, kind="timed_out",
                             reason="deadline exceeded in queue")
             self._emit(ev)
@@ -677,19 +788,30 @@ class Engine:
         while admit:
             req = admit.pop(0)
             slot = free.pop(0)
+            resumed = self._account_pop(req)
             ev = self._admit(req, slot)
             if ev is None:
                 # allocation raced an eviction between gate and admit:
                 # requeue head-first in arrival order and retry next
-                # round — degraded, never dropped
+                # round — degraded, never dropped. EVERY popped request
+                # streams the scheduled/requeued pair so no queue stint
+                # vanishes from the trace: the scheduled event banks
+                # the wait that just ended, the requeue mark starts the
+                # renewed one (and keeps resume flags for the re-pop)
+                req._preempted = resumed
                 for r in reversed([req] + admit):
+                    if r.admitted_at is not None and r is not req:
+                        r._preempted = self._account_pop(r)
+                    self.tracer.event(
+                        "request_requeued", request=r.id,
+                        tick=self._tick_no, reason="alloc_race")
                     self.mgr.release(self._pending_reserve.pop(r.id, 0))
                     self.queue.push_front(r)
                 break
             self._emit(ev)
             emissions.append(ev)
             if ev.finished:
-                self.metrics.on_finish(req)
+                self._on_finished(req)
 
         if self.n_active:
             self._ensure_blocks()
@@ -711,12 +833,20 @@ class Engine:
                 gap_from = getattr(req, "_last_emit_at", None)
                 if gap_from is not None:
                     self.metrics.on_token_gap(tnow - gap_from)
+                    # the gap is wall time shared by every slot: net it
+                    # of ALL sink writes since this request's previous
+                    # emission (its own are charged to client_write;
+                    # neighbours' must not masquerade as decode)
+                    sink = self._sink_s - getattr(
+                        req, "_sink_mark", self._sink_s)
+                    req.decode_s += max(0.0, tnow - gap_from - sink)
                 req._last_emit_at = tnow
+                req._sink_mark = self._sink_s
                 self._emit(ev)
                 emissions.append(ev)
                 emitted += 1
                 if ev.finished:
-                    self.metrics.on_finish(req, tnow)
+                    self._on_finished(req)
                     self._free_slot(s)
             self.metrics.on_tick(dur, emitted)
             self._tick_no += 1
@@ -762,7 +892,11 @@ class Engine:
                     # that raced the drain signal
                     if drain_when() and self.idle:
                         break
-                    self.hb.beat(step=self._tick_no, phase="serve_idle")
+                    # same payload shape as the serve beat so a watcher
+                    # (obs doctor) reads occupancy whichever phase the
+                    # loop froze in
+                    self.hb.beat(step=self._tick_no, phase="serve_idle",
+                                 active=0, queue=len(self.queue))
                     time.sleep(idle_sleep_s)
                     continue
                 self.step()
@@ -778,5 +912,9 @@ class Engine:
                 prefix_hits=summary["prefix_hits"],
                 preempted=summary["preempted"],
             )
-            self.hb.close(phase="done", tokens=summary["tokens"])
+            # the file holds only the LAST beat, so the terminal pulse
+            # repeats the occupancy payload — a watcher reading a
+            # "done" heartbeat still sees what the loop drained to
+            self.hb.close(phase="done", tokens=summary["tokens"],
+                          active=self.n_active, queue=len(self.queue))
         return summary

@@ -23,6 +23,7 @@ import time
 import numpy as np
 
 from hyperion_tpu.obs.registry import percentile
+from hyperion_tpu.obs.timeline import PHASES, cohort_dominant
 from hyperion_tpu.serve.queue import Request
 
 
@@ -43,6 +44,13 @@ class LoadSpec:
     # (the first request prefills the prefix, every later one reuses
     # its blocks). The bench `serving` probe runs this mode.
     shared_prefix_tokens: int = 0
+
+
+def request_id(seed: int, i: int) -> str:
+    """Deterministic, seed-derived request id: the same spec produces
+    the same ids run-to-run, so trace fixtures and bench attribution
+    keys line up across rounds (and across machines)."""
+    return f"load_s{seed}_{i:03d}"
 
 
 def run_load(engine, spec: LoadSpec) -> dict:
@@ -78,7 +86,7 @@ def run_load(engine, spec: LoadSpec) -> dict:
             temperature=spec.temperature,
             seed=int(rng.integers(0, 2**31 - 1)),
             deadline_s=spec.deadline_s,
-            id=f"load_{i}",
+            id=request_id(spec.seed, i),
         )
         for i in range(spec.n_requests)
     ]
@@ -116,6 +124,28 @@ def run_load(engine, spec: LoadSpec) -> dict:
         for r in done if r.finished_at is not None
     ]
     tokens = sum(len(r.tokens) for r in done)
+
+    # per-phase tail attribution over the completed requests (the same
+    # numbers `request_finished` events carry; see obs/timeline.py for
+    # the phase definitions) — p99s ride the bench serving row so
+    # `obs diff` gates WHERE the tail went, not just how long it was
+    def _p99_ms(vals) -> float | None:
+        vals = [v for v in vals if v is not None]
+        return round(percentile(vals, 99), 3) if vals else None
+
+    attribution = {
+        f"{p}_p99_ms": _p99_ms([r.phases_s()[p] * 1e3 for r in done])
+        for p in PHASES
+    }
+    # dominant phase with COHORT semantics (the same math as obs
+    # trace/doctor: average the requests at-or-beyond the e2e p99) —
+    # the independent per-phase p99s above can each come from a
+    # different request, and naming their max would let bench disagree
+    # with the trace tools about the same run
+    dominant = cohort_dominant(
+        [r.finished_at - r.submitted_at for r in done],
+        [r.phases_s() for r in done])
+
     return {
         "requests": spec.n_requests,
         "completed": len(done),
@@ -140,4 +170,6 @@ def run_load(engine, spec: LoadSpec) -> dict:
            for k in ("prefix_hit_rate", "prefill_tokens_saved",
                      "preempted", "cow_copies", "blocks_in_use",
                      "hbm_per_req_mb")},
+        **attribution,
+        "dominant_phase_p99": dominant,
     }

@@ -76,6 +76,21 @@ class ServeMetrics:
         self.reg.histogram("e2e_ms").observe(
             (now - req.submitted_at) * 1e3)
 
+    def on_client_write(self, dur_s: float) -> None:
+        """One transport-sink write (engine `_emit`): the time a slow
+        client charges to its own request."""
+        self.reg.histogram("client_write_ms").observe(dur_s * 1e3)
+
+    def on_phases(self, req) -> None:
+        """Per-phase totals of a finished request (engine
+        `_on_finished`), from the canonical `Request.phases_s` mapping.
+        client_write is skipped: its histogram (`client_write_ms`)
+        observes individual sink writes via `on_client_write`, not
+        per-request totals."""
+        for name, v in req.phases_s().items():
+            if name != "client_write":
+                self.reg.histogram(f"{name}_ms").observe(v * 1e3)
+
     # -------------------------------------------------- paged KV cache
 
     def on_prefix_lookup(self, prompt_tokens: int, cached_tokens: int) -> None:
@@ -167,6 +182,13 @@ class ServeMetrics:
             "ttft_ms": h.get("ttft_ms", {"count": 0}),
             "tpot_ms": h.get("tpot_ms", {"count": 0}),
             "e2e_ms": h.get("e2e_ms", {"count": 0}),
+            # per-phase tail attribution (on_phases/on_client_write)
+            "queue_wait_ms": h.get("queue_wait_ms", {"count": 0}),
+            "gate_wait_ms": h.get("gate_wait_ms", {"count": 0}),
+            "prefill_ms": h.get("prefill_ms", {"count": 0}),
+            "decode_ms": h.get("decode_ms", {"count": 0}),
+            "preempt_replay_ms": h.get("preempt_replay_ms", {"count": 0}),
+            "client_write_ms": h.get("client_write_ms", {"count": 0}),
             "ticks": int(c.get("serve_ticks", 0)),
             # paged-cache pressure (serve/blocks.py)
             "prefix_lookups": int(c.get("serve_prefix_lookups", 0)),

@@ -77,12 +77,42 @@ class Request:
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     status: str = "queued"  # queued|active|done|rejected|timed_out
 
+    # --- per-phase attribution (engine-owned; seconds) ---
+    # Every instant of a request's life lands in exactly one bucket, so
+    # the consumer (`obs trace`) can decompose TTFT/e2e without guessing:
+    #   queue_wait  — FIFO wait before the first slot admission
+    #   gate_wait   — the tail of a queue wait spent denied by the
+    #                 block-availability gate (pool pressure, not FIFO)
+    #   prefill     — the initial prefill call (suffix compute)
+    #   decode      — in-slot tick time between emissions, net of ALL
+    #                 transport-sink writes in the gap (the engine nets
+    #                 at accumulation time: own writes are charged to
+    #                 client_write, a neighbour's slow client must not
+    #                 masquerade as this slot's decode)
+    #   replay      — preemption cost: re-queue wait + re-prefill of
+    #                 prompt+generated after a pool-exhaustion eviction
+    #   client_write— time inside the transport sink (slow consumers)
+    enqueued_at: float = 0.0           # (re)joined the queue at
+    admitted_at: float | None = None   # last queue pop
+    gate_blocked_at: float | None = None  # first block-gate denial at head
+    queue_wait_s: float = 0.0
+    gate_wait_s: float = 0.0
+    prefill_s: float = 0.0
+    decode_s: float = 0.0
+    replay_s: float = 0.0
+    client_write_s: float = 0.0
+    preempts: int = 0
+    finish_reason: str | None = None   # eos|budget|rejected|timed_out
+    _preempted: bool = False           # next pop is a replay resume
+
     def __post_init__(self):
         self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
         if not self.id:
             self.id = f"req_{next(_ids)}"
         if not self.submitted_at:
             self.submitted_at = time.monotonic()
+        if not self.enqueued_at:
+            self.enqueued_at = self.submitted_at
 
     @property
     def prompt_len(self) -> int:
@@ -93,6 +123,22 @@ class Request:
         if self.deadline_s is None:
             return None
         return self.submitted_at + self.deadline_s
+
+    def phases_s(self) -> dict[str, float]:
+        """Per-phase totals keyed by the canonical phase vocabulary
+        (`obs/timeline.py:PHASES`). THE field→phase mapping: every
+        producer (the `request_finished` event, the phase histograms,
+        loadgen's bench attribution) builds from this one dict, so a
+        new phase is wired in here once or the reporters silently
+        disagree."""
+        return {
+            "queue_wait": self.queue_wait_s,
+            "gate_wait": self.gate_wait_s,
+            "prefill": self.prefill_s,
+            "decode": self.decode_s,
+            "preempt_replay": self.replay_s,
+            "client_write": self.client_write_s,
+        }
 
 
 class AdmissionQueue:
@@ -123,6 +169,11 @@ class AdmissionQueue:
         """(accepted, reject_reason). Rejection is immediate and final —
         the caller owns retry policy, the queue never buffers beyond
         `capacity`."""
+        # a request may be constructed long before it is handed over
+        # (loadgen builds its whole arrival schedule up front): the life
+        # clock — TTFT/e2e/deadline/queue_wait — starts at the door,
+        # else pre-submit idle time masquerades as queue wait
+        req.submitted_at = req.enqueued_at = time.monotonic()
         if req.max_new_tokens < 1:
             req.status = "rejected"
             return False, REJECT_BAD_REQUEST
@@ -177,9 +228,15 @@ class AdmissionQueue:
                 if head.prompt_len > budget and admit:
                     break  # next round gets a fresh budget for it
                 if can_admit is not None and not can_admit(head):
-                    break  # pool pressure: wait for blocks to free up
+                    # pool pressure: wait for blocks to free up. Stamp
+                    # the FIRST denial so the engine can split this
+                    # head's wait into FIFO time vs block-gate time.
+                    if head.gate_blocked_at is None:
+                        head.gate_blocked_at = now
+                    break
                 self._q.popleft()
                 head.status = "active"
+                head.admitted_at = now
                 admit.append(head)
                 budget -= head.prompt_len
                 if budget <= 0:
@@ -192,6 +249,7 @@ class AdmissionQueue:
         they resume first, so preemption degrades latency, never
         fairness."""
         req.status = "queued"
+        req.enqueued_at = time.monotonic()
         with self._lock:
             self._q.appendleft(req)
 

@@ -355,6 +355,15 @@ def main(argv=None) -> int:
         pass
     finally:
         tracer.close()
+        if tracer.enabled:
+            # every request's lifecycle (queue/gate/prefill/decode/
+            # client-write, with client-write timed around the sink
+            # calls this process just made) is on the stream — point at
+            # the consumer instead of making the operator remember it
+            print(f"[serve] request traces at {tracer.path} — inspect "
+                  f"with `python -m hyperion_tpu.cli.main obs trace "
+                  f"{tracer.path}`",
+                  file=sys.stderr)
     return 0
 
 

@@ -97,3 +97,29 @@ assert saved > 0, "prefix hit saved zero prefill tokens"
 print(f"[serve_smoke] OK: prefix round trip — {hits} hit(s), "
       f"{saved} prefill tokens saved")
 PY
+
+# 5. `obs trace` round trip on the run we just produced: the trace
+#    consumer must reconstruct every request, export a non-empty Chrome
+#    trace, and attribute the tail — the observability half of the
+#    serve path proven against a real stream, not a fixture
+python -m hyperion_tpu.cli.main obs trace "$WORK/tele.jsonl" \
+  --export "$WORK/trace.json" --top 3 > "$WORK/trace.md"
+
+python - "$WORK/trace.json" "$WORK/trace.md" <<'PY'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+evs = doc.get("traceEvents", [])
+assert evs, "obs trace exported an empty Chrome trace"
+xs = [e for e in evs if e.get("ph") == "X"]
+assert xs, "no complete (X) events in the export"
+assert all("ts" in e and e.get("dur", 0) >= 0 for e in xs)
+reqs = {e["args"]["request"] for e in evs
+        if e.get("args", {}).get("request")}
+assert {"p1", "p2"} <= reqs, f"missing request rows: {reqs}"
+md = open(sys.argv[2]).read()
+assert "Tail attribution" in md and "dominant" in md
+print(f"[serve_smoke] OK: obs trace — {len(evs)} trace events, "
+      f"{len(reqs)} request rows, attribution table rendered")
+PY

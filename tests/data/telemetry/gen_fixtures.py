@@ -17,6 +17,13 @@ test asserts the record fields stay stable):
                 "hung", staleness outranking the stall pattern
     crashed/  — stream ends mid-record (the killed-process signature);
                 heartbeat frozen in phase "train"
+    serve/    — a drained serve run whose p99 TTFT is dominated by
+                queue wait: full request lifecycles (admitted →
+                scheduled → prefill span → first token → finished with
+                per-phase totals), one preempt-replay, one reject and
+                one timeout with `queued_s` — the golden stream
+                `obs trace` reconstructs and `obs doctor` must raise a
+                named queue-wait incident on (tests/test_timeline.py)
 
 Everything is driven by fake clocks pinned to _WALL0 so the files are
 byte-stable across regenerations (no real time leaks in). The committed
@@ -159,10 +166,109 @@ def crashed():
         f.write('{"v":1,"kind":"span","name":"train_step","run":"fix_crash')
 
 
+def serve():
+    """Queue-wait-dominated serve run. Phase numbers are constructed so
+    every `request_finished` decomposes exactly (components + other ==
+    e2e) and queue wait owns ~80% of the p99 TTFT — the named-incident
+    threshold case for `obs doctor`."""
+    d, t, hb, clk, wall = _setup("serve", "fix_serve")
+
+    def adv(s: float) -> None:
+        clk.advance(s)
+        wall.advance(s)
+
+    t.event("serve_start", slots=2, max_len=64, block_size=8,
+            num_blocks=17, prefix_cache=True)
+    hb.pulse(phase="serve", step=0, active=0, queue=0)
+    # engine row: a few ticks so doctor sees step spans too
+    for i in range(6):
+        with t.span("serve_tick", step=i) as sp:
+            adv(0.010)
+            sp.set(active=2)
+    # six completed requests, FIFO waits 300..400 ms >> 20 ms prefill
+    queue_waits = [0.30, 0.32, 0.34, 0.35, 0.38, 0.40]
+    prefill_s, decode_s, cw_s = 0.020, 0.050, 0.002
+    for i, qw in enumerate(queue_waits):
+        rid = f"r{i}"
+        preempted = i == 3
+        t.event("request_admitted", request=rid, prompt_len=16,
+                max_new_tokens=8, deadline_s=None)
+        adv(qw)
+        t.event("request_scheduled", request=rid, tick=6 + i,
+                resumed=False, queue_wait_s=qw, gate_wait_s=0.0,
+                replay_wait_s=0.0)
+        with t.span("serve_prefill", step=6 + i) as sp:
+            adv(prefill_s)
+            sp.set(request=rid, slot=i % 2, prompt_len=16,
+                   cached_tokens=0, bucket=16, resumed=False)
+        t.event("request_first_token", request=rid, tick=6 + i,
+                ttft_s=qw + prefill_s, queue_wait_s=qw,
+                gate_wait_s=0.0, prefill_s=prefill_s)
+        replay_s = 0.0
+        if preempted:
+            adv(decode_s / 2)
+            t.event("request_preempted", request=rid, generated=4,
+                    tick=7 + i)
+            adv(0.060)  # replay queue wait
+            t.event("request_scheduled", request=rid, tick=8 + i,
+                    resumed=True, queue_wait_s=0.0, gate_wait_s=0.0,
+                    replay_wait_s=0.060)
+            with t.span("serve_prefill", step=8 + i) as sp:
+                adv(0.020)  # replay re-prefill
+                sp.set(request=rid, slot=i % 2, prompt_len=20,
+                       cached_tokens=16, bucket=4, resumed=True)
+            replay_s = 0.080
+            adv(decode_s / 2)
+        else:
+            adv(decode_s)
+        adv(cw_s + 0.001)  # sink writes + unattributed remainder
+        t.event(
+            "request_finished", request=rid, tick=9 + i, reason="budget",
+            prompt_len=16, n_tokens=8, preempts=1 if preempted else 0,
+            e2e_s=round(qw + prefill_s + decode_s + replay_s + cw_s
+                        + 0.001, 6),
+            ttft_s=round(qw + prefill_s, 6),
+            queue_wait_s=qw, gate_wait_s=0.0, prefill_s=prefill_s,
+            decode_s=decode_s, preempt_replay_s=replay_s,
+            client_write_s=cw_s)
+        hb.beat(step=10 + i, phase="serve", active=2, queue=4 - i)
+    # the requests that died at the door / in the queue stay visible
+    t.event("request_rejected", request="r6", reason="queue_full",
+            prompt_len=16, queued_s=0.0)
+    t.event("request_admitted", request="r7", prompt_len=16,
+            max_new_tokens=8, deadline_s=0.5)
+    adv(0.600)
+    t.event("request_timeout", request="r7", waited_s=0.6, queued_s=0.6)
+    reg = MetricsRegistry()
+    reg.counter("serve_ticks").inc(12)
+    reg.counter("serve_completed").inc(6)
+    reg.counter("serve_rejected").inc(1)
+    reg.counter("serve_timed_out").inc(1)
+    reg.counter("serve_preempted").inc(1)
+    reg.counter("serve_prefix_lookups").inc(6)
+    reg.counter("serve_prefix_hits").inc(0)
+    reg.gauge("queue_depth").set(0.0)
+    reg.gauge("slot_occupancy").set(0.0)
+    reg.gauge("tokens_per_s").set(18.0)
+    for qw in queue_waits:
+        reg.histogram("ttft_ms").observe((qw + prefill_s) * 1e3)
+        reg.histogram("queue_wait_ms").observe(qw * 1e3)
+    t.snapshot(reg, step=12)
+    t.event("serve_end", ticks=12, completed=6, rejected=1, timed_out=1,
+            tokens=48, prefix_hits=0, preempted=1)
+    hb.close(phase="done", tokens=48, active=0, queue=0)
+    t.close()
+
+
 def main() -> int:
-    for fn in (healthy, nan, stalled, hung, crashed):
-        fn()
-        print(f"wrote {fn.__name__}/")
+    from unittest import mock
+
+    # Heartbeat stamps os.getpid() into heartbeat.json; pin it so
+    # regeneration really is byte-stable (the clocks already are)
+    with mock.patch("os.getpid", return_value=4242):
+        for fn in (healthy, nan, stalled, hung, crashed, serve):
+            fn()
+            print(f"wrote {fn.__name__}/")
     return 0
 
 

@@ -23,7 +23,7 @@ from hyperion_tpu.obs.trace import Tracer
 FIXTURES = Path(__file__).parent / "data" / "telemetry"
 REPO = Path(__file__).resolve().parents[1]
 
-ALL_FIXTURES = ("healthy", "nan", "stalled", "hung", "crashed")
+ALL_FIXTURES = ("healthy", "nan", "stalled", "hung", "crashed", "serve")
 
 
 class FakeClock:

@@ -617,6 +617,71 @@ class TestServeTelemetry:
         assert dd["comparable_metrics"] > 0
         assert dd["regressions"] == []
 
+    def test_trace_round_trip_and_attribution(self, tmp_path, llama):
+        """`obs trace` consumes a REAL engine stream (not a fixture):
+        every request reconstructs with its lifecycle events, the
+        phase totals partition e2e, and the Chrome export is
+        non-empty. Shapes match `_run_serve`, so nothing recompiles."""
+        from hyperion_tpu.obs import timeline
+        from hyperion_tpu.obs.report import read_records
+
+        self._run_serve(tmp_path, llama)
+        records = read_records(tmp_path / "telemetry.jsonl")
+        names = {r.get("name") for r in records
+                 if r.get("kind") == "event"}
+        assert {"request_admitted", "request_scheduled",
+                "request_first_token", "request_finished"} <= names
+        reqs = timeline.requests_from_records(records)
+        done = [r for r in reqs if r.status == "done"]
+        assert len(done) == 4
+        for r in done:
+            assert r.e2e_s is not None and r.e2e_s > 0
+            assert r.phases["prefill"] > 0
+            # phases never over-attribute, and the unexplained
+            # remainder stays a minority (generous bound: CI boxes
+            # under parallel load jitter hard)
+            assert sum(r.phases.values()) <= r.e2e_s + 1e-6
+            assert r.other_s < max(0.5 * r.e2e_s, 0.05)
+        att = timeline.attribution(reqs)
+        assert att["rows"]
+        for row in att["rows"]:
+            total = sum(row["components_ms"].values()) + row["other_ms"]
+            assert total == pytest.approx(row["value_ms"], abs=0.02)
+        assert timeline.chrome_trace(reqs, records)["traceEvents"]
+
+    def test_slow_sink_charged_to_client_write(self, tmp_path, llama):
+        """A slow CLIENT must show up as client_write in its own
+        request's attribution — not inflate the decode phase and send
+        an operator hunting a device problem that isn't there."""
+        from hyperion_tpu.obs import timeline
+        from hyperion_tpu.obs.report import read_records
+        from hyperion_tpu.obs.trace import Tracer
+
+        model, variables = llama
+        tracer = Tracer(tmp_path / "telemetry.jsonl", run="slow_sink")
+        eng = Engine(model, variables,
+                     EngineConfig(slots=2, max_len=48, eos_id=None),
+                     tracer=tracer)
+        eng.warmup([8])
+        req = Request(prompt_ids=_prompts([6], seed=2)[0],
+                      max_new_tokens=4, id="slow",
+                      sink=lambda ev: time.sleep(0.005))
+        eng.submit(req)
+        _drain(eng)
+        tracer.close()
+        assert req.client_write_s >= 0.015  # ≥4 writes × 5 ms, minus slop
+        reqs = timeline.requests_from_records(
+            read_records(tmp_path / "telemetry.jsonl"))
+        (rt,) = [r for r in reqs if r.id == "slow"]
+        assert rt.phases["client_write"] >= 0.015
+        # decode is netted of sink time: both can't claim the same ms
+        assert rt.phases["decode"] + rt.phases["client_write"] \
+            <= rt.e2e_s + 1e-6
+        att = timeline.attribution(reqs)
+        e2e99 = next(r for r in att["rows"]
+                     if r["metric"] == "e2e" and r["q"] == 99)
+        assert e2e99["dominant"] == "client_write"
+
     def test_serving_probe_shape_diffs(self, tmp_path):
         """The bench `serving` row diffs like the input_pipeline probe:
         a slower/more-rejecting run regresses in the right metrics."""
@@ -853,6 +918,12 @@ class TestLoadGenerator:
         assert a["completed"] + a["rejected"] + a["timed_out"] == 10
         if a["completed"]:
             assert a["ttft_p50_ms"] is not None
+            # the attribution keys obs diff gates ride every report
+            for k in ("queue_wait_p99_ms", "prefill_p99_ms",
+                      "decode_p99_ms", "preempt_replay_p99_ms",
+                      "client_write_p99_ms"):
+                assert a[k] is not None, k
+            assert a["dominant_phase_p99"] is not None
 
     def test_all_rejected_load_still_reports(self, llama):
         """A spec whose every request is door-rejected (too_long) with
