@@ -297,21 +297,9 @@ def run_job(args, job: str):
 
 
 def _strip_supervise_flags(argv: list[str]) -> list[str]:
-    """The child command = this command minus the supervision flags —
-    a supervised child must never recursively supervise."""
-    out, skip = [], False
-    for a in argv:
-        if skip:
-            skip = False
-        elif a == "--supervise":
-            pass
-        elif a == "--max-restarts":
-            skip = True
-        elif a.startswith("--max-restarts="):
-            pass
-        else:
-            out.append(a)
-    return out
+    from hyperion_tpu.supervisor import strip_flags
+
+    return strip_flags(argv, {"--supervise"}, {"--max-restarts"})
 
 
 def main(argv=None) -> int:

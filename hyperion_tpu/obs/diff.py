@@ -68,6 +68,11 @@ METRICS: dict[str, str] = {
     "serve_decode_p99_ms": "lower",
     "serve_preempt_replay_p99_ms": "lower",
     "serve_client_write_p99_ms": "lower",
+    # overload brownout (serve/queue.py:BrownoutGovernor via the bench
+    # serving row): more shed or clamped requests at the same offered
+    # load means lost capacity — gated like any other serving regression
+    "serve_shed_rate": "lower",
+    "serve_clamp_rate": "lower",
 }
 
 
@@ -137,7 +142,9 @@ def normalize(doc: dict) -> dict[str, float]:
                               ("preempt_replay_p99_ms",
                                "serve_preempt_replay_p99_ms"),
                               ("client_write_p99_ms",
-                               "serve_client_write_p99_ms")):
+                               "serve_client_write_p99_ms"),
+                              ("shed_rate", "serve_shed_rate"),
+                              ("clamp_rate", "serve_clamp_rate")):
                 v = _num(srv.get(src))
                 if v is not None:
                     out[name] = v

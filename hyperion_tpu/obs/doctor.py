@@ -213,6 +213,14 @@ def diagnose(
                 "prefix_hit_rate": g.get("serve_prefix_hit_rate"),
                 "blocks_in_use": g.get("serve_blocks_in_use"),
                 "hbm_per_req_mb": g.get("serve_hbm_per_req_mb"),
+                # crash safety + overload (serve/journal.py, brownout)
+                "shed": c.get("serve_shed"),
+                "brownout_clamped": c.get("serve_brownout_clamped"),
+                "brownout_active": g.get("serve_brownout_active"),
+                "replayed": c.get("serve_replayed"),
+                "poisoned": c.get("serve_poisoned"),
+                "journal_errors": c.get("serve_journal_errors"),
+                "dropped_sinks": c.get("serve_dropped_sinks"),
             }
 
     # ---- stall signal: tail steps vs the run's own earlier median ----
@@ -338,6 +346,40 @@ def diagnose(
                                       "failed"):
         reason += "; cache pressure: " + "; ".join(cache_pressure)
 
+    # Overload + crash-safety incidents (PR 8): shed/clamped requests
+    # mean the brownout governor fired — the server DEGRADED instead of
+    # collapsing, which is working as designed but is still a capacity
+    # fact the operator must hear by name; poisoned requests and
+    # journal IO errors are robustness events that must never hide
+    # inside aggregate counters.
+    overload: list[str] = []
+    if serve and serve.get("shed"):
+        overload.append(
+            f"overload brownout shed {int(serve['shed'])} "
+            "deadline-doomed request(s) — offered load exceeded "
+            "capacity; raise --slots, add replicas, or loosen deadlines")
+    if serve and serve.get("brownout_clamped"):
+        overload.append(
+            f"brownout clamped max_new_tokens on "
+            f"{int(serve['brownout_clamped'])} admission(s)")
+    if serve and serve.get("brownout_active"):
+        overload.append("brownout still ACTIVE at the last snapshot — "
+                        "the run ended under overload")
+    poisoned_ids = [str(e.get("request")) for e in events
+                    if e.get("name") == "request_poisoned"]
+    if poisoned_ids:
+        overload.append(
+            f"poison pill: request(s) {', '.join(sorted(poisoned_ids))} "
+            "quarantined after repeated crash-replays — inspect the "
+            "journal before re-submitting them")
+    if serve and serve.get("journal_errors"):
+        overload.append(
+            "request journal hit an IO error and was DISABLED — the "
+            "run served on without crash recovery")
+    if overload and verdict in ("healthy", "running", "stalled",
+                                "failed", "crashed", "hung"):
+        reason += "; serving robustness: " + "; ".join(overload)
+
     # Tail-attribution incidents (obs/timeline.py): the request-scoped
     # trace says WHERE the p99 went, so the doctor can name the FIX —
     # "raise --slots" and "raise --num-blocks" are different knobs a
@@ -367,8 +409,19 @@ def diagnose(
                 msg = (f"p99 {row['metric']} dominated by block-gate "
                        f"wait ({where}) — raise --num-blocks")
             elif row["metric"] == "e2e" and dom == "preempt_replay":
-                msg = (f"p99 e2e dominated by preempt replay ({where}) "
-                       "— --num-blocks undersized for this load")
+                if serve and serve.get("replayed") \
+                        and not serve.get("preempted"):
+                    # same attribution bucket, different culprit: these
+                    # replays were crash recoveries (journal), not
+                    # pool-exhaustion preemptions — resizing the pool
+                    # would fix nothing
+                    msg = (f"p99 e2e dominated by replay ({where}) — "
+                           "crash-recovery replays (restart cost), not "
+                           "pool pressure")
+                else:
+                    msg = (f"p99 e2e dominated by preempt replay "
+                           f"({where}) — --num-blocks undersized for "
+                           "this load")
             elif row["metric"] == "e2e" and dom == "client_write":
                 msg = (f"p99 e2e dominated by client writes ({where}) "
                        "— slow consumer, not a slow engine")
@@ -420,6 +473,8 @@ def diagnose(
         "hbm_peak_mb": hbm_peak,
         "serve": serve,
         "cache_pressure": cache_pressure,
+        "overload": overload,
+        "poisoned_requests": poisoned_ids,
         "tail_attribution": tail_rows,
         "tail_incidents": tail_incidents,
         "tail_incident_metrics": tail_incident_metrics,
@@ -519,6 +574,16 @@ def render_markdown(d: dict) -> str:
             lines.append(
                 f"| TTFT p50 / p99 | {_fmt(srv['ttft_p50_ms'])} / "
                 f"{_fmt(srv['ttft_p99_ms'])} ms |")
+        if srv.get("shed") or srv.get("brownout_clamped") \
+                or srv.get("brownout_active") or srv.get("replayed") \
+                or srv.get("poisoned") or srv.get("journal_errors"):
+            flag = " — **overload**" if d.get("overload") else ""
+            lines.append(
+                f"| serve robustness | shed {_fmt(srv.get('shed'))}, "
+                f"clamped {_fmt(srv.get('brownout_clamped'))}, "
+                f"replayed {_fmt(srv.get('replayed'))}, poisoned "
+                f"{_fmt(srv.get('poisoned'))}, journal errors "
+                f"{_fmt(srv.get('journal_errors'))}{flag} |")
         if srv.get("blocks_in_use") is not None \
                 or srv.get("prefix_lookups") is not None:
             flag = " — **cache pressure**" if d.get("cache_pressure") else ""

@@ -16,8 +16,17 @@ its lifecycle (admitted → scheduled → prefill → first token →
 finished, with per-phase wait/compute/transport totals) onto the obs
 telemetry stream; `obs trace` (`obs/timeline.py`) turns that into
 waterfalls, Chrome trace exports, and tail-latency attribution.
+The request path is also crash-safe: an append-only request journal
+(`journal`) write-ahead-logs every admission and emitted token so a
+killed engine's supervised restart (`hyperion serve --supervise`, on
+the shared `hyperion_tpu/supervisor.py` core) replays unfinished
+requests to bit-identical completion, with a poison-pill rule for
+requests that crash the engine repeatedly; SIGTERM drains gracefully,
+and an overload brownout governor (`queue.BrownoutGovernor`) sheds
+deadline-doomed work with hysteresis instead of collapsing.
 `SERVING.md` documents the paged design, why recompile-free refill is
-the whole game on TPU, and the tracing event vocabulary.
+the whole game on TPU, the tracing event vocabulary, and the crash
+recovery / drain / brownout semantics.
 """
 
 from hyperion_tpu.serve.blocks import (  # noqa: F401
@@ -25,6 +34,11 @@ from hyperion_tpu.serve.blocks import (  # noqa: F401
     RadixPrefixCache,
 )
 from hyperion_tpu.serve.engine import Engine, EngineConfig, TokenEvent  # noqa: F401
+from hyperion_tpu.serve.journal import RequestJournal  # noqa: F401
 from hyperion_tpu.serve.loadgen import LoadSpec, run_load  # noqa: F401
 from hyperion_tpu.serve.metrics import ServeMetrics  # noqa: F401
-from hyperion_tpu.serve.queue import AdmissionQueue, Request  # noqa: F401
+from hyperion_tpu.serve.queue import (  # noqa: F401
+    AdmissionQueue,
+    BrownoutGovernor,
+    Request,
+)

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import sys
 import time
 from typing import Any, Callable
 
@@ -69,8 +70,17 @@ from hyperion_tpu.serve.blocks import (
     SeqAlloc,
     blocks_for,
 )
+from hyperion_tpu.serve.journal import MAX_REPLAYS_DEFAULT
 from hyperion_tpu.serve.metrics import ServeMetrics
-from hyperion_tpu.serve.queue import AdmissionQueue, Request
+from hyperion_tpu.serve.queue import (
+    REJECT_BAD_REQUEST,
+    REJECT_DRAINING,
+    REJECT_POISONED,
+    REJECT_SHED,
+    AdmissionQueue,
+    BrownoutGovernor,
+    Request,
+)
 
 _SNAPSHOT_EVERY = 32  # ticks between metric snapshots on the stream
 
@@ -207,6 +217,11 @@ class EngineConfig:
     # when the pool runs dry (vLLM's default posture; higher occupancy,
     # tail-latency risk under pathological growth).
     admission: str = "reserve"
+    # ---- overload brownout (serve/queue.py:BrownoutGovernor) ----
+    brownout: bool = False         # enable the governor
+    brownout_depth: int = 0        # enter watermark (0 = 3/4 of capacity)
+    brownout_wait_s: float = 0.0   # queue-wait p95 enter watermark (0 = off)
+    brownout_clamp: int = 0        # clamp max_new_tokens while active (0 = off)
 
 
 @dataclasses.dataclass
@@ -238,6 +253,7 @@ class Engine:
         tracer=None,
         heartbeat=None,
         chaos=None,
+        journal=None,
         on_event: Callable[[TokenEvent], Any] | None = None,
     ):
         from hyperion_tpu.models.llama import (
@@ -276,6 +292,18 @@ class Engine:
             else hb_mod.null_heartbeat()
         self.chaos = chaos
         self.on_event = on_event
+        # crash-safety + overload state (PR 8)
+        self.journal = journal
+        self._journal_err_reported = False
+        self._draining = False
+        self._drain_deadline: float | None = None
+        self._unparsed = itertools.count()
+        self._governor: BrownoutGovernor | None = None
+        if cfg.brownout:
+            depth_high = cfg.brownout_depth or max(
+                1, (3 * cfg.queue_capacity) // 4)
+            self._governor = BrownoutGovernor(
+                depth_high=depth_high, wait_high_s=cfg.brownout_wait_s)
         self._slots: list[Request | None] = [None] * cfg.slots
         self._seqs: list[SeqAlloc | None] = [None] * cfg.slots
         self.mgr = BlockManager(num_blocks, bs)
@@ -614,6 +642,10 @@ class Engine:
         else:
             req.gate_wait_s += gate
             req.queue_wait_s += wait - gate
+        if self._governor is not None:
+            # every completed wait (replay stints included — congestion
+            # is congestion) feeds the brownout p95 window
+            self._governor.observe_wait(wait)
         self.tracer.event(
             "request_scheduled", request=req.id, tick=self._tick_no,
             resumed=resumed,
@@ -652,12 +684,40 @@ class Engine:
 
     # ------------------------------------------------------------ events
 
+    def _journal_guard(self) -> None:
+        """Surface a journal IO failure exactly once: the engine keeps
+        serving (durability degraded beats dead), but the stream and
+        the counters must say so — a silent WAL loss would read as
+        crash-safe right up to the crash."""
+        j = self.journal
+        if j is not None and not j.enabled and not self._journal_err_reported:
+            self._journal_err_reported = True
+            self.metrics.on_journal_error()
+            self.tracer.event("journal_io_error", error=j.error)
+            print(f"[serve] journal disabled after IO error: {j.error} — "
+                  "serving continues WITHOUT crash recovery",
+                  file=sys.stderr)
+
     def _emit(self, ev: TokenEvent) -> None:
         req = ev.request
         if ev.kind == "token" and ev.token is not None:
             req.tokens.append(ev.token)
         if ev.finished and ev.kind == "token":
             req.status = "done"
+        # Journal BEFORE the sink write, flushed to the kernel inside
+        # `token`/`finish` (serve/journal.py's ordering contract): any
+        # token a client ever received is already durable, so a replay
+        # can never re-compute — hence never re-deliver — it. The
+        # client stream stays duplicate-free across kills.
+        if self.journal is not None and req._journaled:
+            if ev.kind == "token" and ev.token is not None:
+                self.journal.token(req.id, ev.token)
+            if ev.finished:
+                self.journal.finish(
+                    req.id,
+                    "done" if ev.kind in ("token", "done")
+                    else (ev.reason or ev.kind))
+            self._journal_guard()
         if self.chaos is not None:
             self.chaos.on_client(self._tick_no)
         if req.sink is not None:
@@ -667,8 +727,12 @@ class Engine:
             except Exception:  # noqa: BLE001
                 # a client that died mid-stream must cost ITS request,
                 # never the engine: drop the sink, let the slot finish
-                # out its budget (eos/budget latch frees it)
+                # out its budget (eos/budget latch frees it) — and say
+                # so on the stream, a vanished consumer is evidence
                 req.sink = None
+                self.metrics.on_dropped_sink()
+                self.tracer.event("client_disconnected", request=req.id,
+                                  tick=self._tick_no)
             # charge transport time to the REQUEST (a slow client must
             # show up in its own tail attribution, not vanish into the
             # decode gap it inflates)
@@ -725,13 +789,35 @@ class Engine:
     def submit(self, req: Request) -> tuple[bool, str | None]:
         """Queue a request (thread-safe). Rejections emit immediately —
         backpressure the caller can act on, not a silent drop."""
+        gov = self._governor
+        if gov is not None and gov.active and self.cfg.brownout_clamp > 0 \
+                and req.max_new_tokens > self.cfg.brownout_clamp:
+            # brownout clamp, applied BEFORE the journal sees the
+            # request: the WAL must record the budget actually served,
+            # or a replay would un-clamp it mid-overload
+            req.clamped_from = req.max_new_tokens
+            req.max_new_tokens = self.cfg.brownout_clamp
+        if self.journal is not None:
+            # write-AHEAD of queue.submit: the instant the request is
+            # in the queue the engine thread may pop it and emit its
+            # first token, and that token's journal record needs the
+            # admit record already on disk. A door rejection below
+            # closes the speculative record with a terminal one, so it
+            # can never replay.
+            self.journal.admit(req)
+            req._journaled = True
+            self._journal_guard()
         ok, reason = self.queue.submit(req)
         if ok:
             self.metrics.on_accept()
+            if req.clamped_from is not None:
+                self.metrics.on_clamp()
             self.tracer.event("request_admitted", request=req.id,
                               prompt_len=req.prompt_len,
                               max_new_tokens=req.max_new_tokens,
-                              deadline_s=req.deadline_s)
+                              deadline_s=req.deadline_s,
+                              **({"clamped_from": req.clamped_from}
+                                 if req.clamped_from is not None else {}))
         else:
             # queued_s: rejection happens at the door, so the request
             # spent zero time queued — the key exists so rejects land in
@@ -744,6 +830,108 @@ class Engine:
             self._emit(TokenEvent(req, None, True, kind="rejected",
                                   reason=reason))
         return ok, reason
+
+    def reject_unparsed(self, rid: str | None, error: str) -> None:
+        """Front-end hand-off for a line that never became a Request:
+        counted and evented like a door reject so malformed input is
+        visible in the same tables — and never an engine-thread
+        exception, whatever the line contained."""
+        self.metrics.on_reject(REJECT_BAD_REQUEST)
+        self.tracer.event(
+            "request_rejected",
+            request=rid or f"unparsed_{next(self._unparsed)}",
+            reason=REJECT_BAD_REQUEST, error=str(error)[:200],
+            queued_s=0.0)
+
+    # ------------------------------------------------- drain + recovery
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self, timeout_s: float = 30.0) -> None:
+        """Flip to graceful drain (idempotent): the queue closes with
+        `reject(reason="draining")`, in-flight slots — and requests
+        already accepted into the queue — run to eos/budget, bounded by
+        `timeout_s`. The SIGTERM/SIGINT path (serve/server.py) lands
+        here."""
+        if self._draining:
+            return
+        self._draining = True
+        self._drain_deadline = time.monotonic() + max(0.0, timeout_s)
+        self.queue.close(REJECT_DRAINING)
+        self.tracer.event("serve_draining", tick=self._tick_no,
+                          active=self.n_active, queue=len(self.queue),
+                          timeout_s=timeout_s)
+        self.hb.pulse(phase="drain", step=self._tick_no,
+                      active=self.n_active, queue=len(self.queue))
+
+    def drain_expired(self) -> bool:
+        return (self._draining and self._drain_deadline is not None
+                and time.monotonic() > self._drain_deadline)
+
+    def replay_pending(self, sink=None, *,
+                       max_replays: int = MAX_REPLAYS_DEFAULT) -> dict:
+        """Recover the journal into this engine — called once, after
+        `warmup`, before the serve loop. Unfinished journaled requests
+        re-enter HEAD of queue (original admit order preserved) with
+        their generated tokens riding along; the next pop re-prefills
+        prompt + generated through the same recompute path preemption
+        uses, so the continuation is bit-identical and `obs trace`
+        shows it as a resumed request. Requests whose output was
+        already complete just owe the client a terminal event; requests
+        that crashed the engine `max_replays` times are quarantined
+        with a `request_poisoned` event instead of crash-looping."""
+        if self.journal is None:
+            return {"resumed": 0, "finished": 0, "poisoned": 0,
+                    "clean": True}
+        resume, finished, poisoned, clean = self.journal.recover(
+            max_replays=max_replays, eos_id=self.cfg.eos_id)
+        self._journal_guard()
+        for req in finished:
+            req.sink = sink
+            req.status = "done"
+            req.finish_reason = "recovered_complete"
+            self.tracer.event(
+                "request_finished", request=req.id, tick=self._tick_no,
+                reason="recovered_complete", prompt_len=req.prompt_len,
+                n_tokens=len(req.tokens), preempts=req.preempts,
+                replayed=True)
+            self._emit(TokenEvent(req, None, True, kind="done",
+                                  reason="recovered_complete"))
+        for req in poisoned:
+            req.sink = sink
+            req.status = "rejected"
+            req.finish_reason = REJECT_POISONED
+            self.metrics.on_poisoned()
+            self.tracer.event(
+                "request_poisoned", request=req.id, replays=req.replays,
+                prompt_len=req.prompt_len, generated=len(req.tokens))
+            self._emit(TokenEvent(req, None, True, kind="rejected",
+                                  reason=REJECT_POISONED))
+        for req in reversed(resume):  # reversed: first-admitted at head
+            req.sink = sink
+            req._journaled = True
+            if req.tokens:
+                # resumed mid-decode: its next queue wait banks as
+                # replay, its prefill as replay_prefill, and no second
+                # first-token event fires — the PR-7 resume vocabulary
+                req._preempted = True
+                req.first_token_at = req.submitted_at
+            self.metrics.on_replay()
+            self.tracer.event(
+                "request_admitted", request=req.id,
+                prompt_len=req.prompt_len,
+                max_new_tokens=req.max_new_tokens,
+                deadline_s=req.deadline_s, replayed=True,
+                replay_n=req.replays, generated=len(req.tokens))
+            self.queue.push_front(req)
+        if resume or finished or poisoned:
+            self.tracer.event("journal_replayed", resumed=len(resume),
+                              finished=len(finished),
+                              poisoned=len(poisoned))
+        return {"resumed": len(resume), "finished": len(finished),
+                "poisoned": len(poisoned), "clean": clean}
 
     @property
     def n_active(self) -> int:
@@ -760,6 +948,42 @@ class Engine:
         all active slots one token, route emissions."""
         emissions: list[TokenEvent] = []
         now = time.monotonic()
+
+        if self._governor is not None:
+            tr = self._governor.update(len(self.queue))
+            if tr == "enter":
+                self.metrics.set_brownout(True)
+                self.tracer.event(
+                    "brownout_enter", tick=self._tick_no,
+                    depth=len(self.queue),
+                    wait_p95_ms=round(self._governor.wait_p95() * 1e3, 3))
+            elif tr == "exit":
+                self.metrics.set_brownout(False)
+                self.tracer.event("brownout_exit", tick=self._tick_no,
+                                  depth=len(self.queue))
+            if self._governor.active:
+                # shed deadline-aware, cheapest first: queued requests
+                # that cannot meet their deadline even if service began
+                # after the current estimated wait are already doomed —
+                # reject them NOW so the client retries elsewhere
+                # instead of burning a queue slot toward a timeout
+                for req in self.queue.shed_doomed(
+                        now, self._governor.wait_p95()):
+                    self.metrics.on_shed()
+                    req.finish_reason = REJECT_SHED
+                    # the standard reject vocabulary (shed=true rides
+                    # along): `obs trace` keeps shed requests in the
+                    # same attribution tables as door rejects, with
+                    # the queue time they DID burn before dying
+                    self.tracer.event(
+                        "request_rejected", request=req.id,
+                        tick=self._tick_no, reason=REJECT_SHED, shed=True,
+                        queued_s=round(max(0.0, now - req.enqueued_at), 6),
+                        deadline_s=req.deadline_s)
+                    ev = TokenEvent(req, None, True, kind="rejected",
+                                    reason=REJECT_SHED)
+                    self._emit(ev)
+                    emissions.append(ev)
 
         free = [s for s, r in enumerate(self._slots) if r is None]
         if free:
@@ -788,6 +1012,12 @@ class Engine:
         while admit:
             req = admit.pop(0)
             slot = free.pop(0)
+            if self.chaos is not None:
+                # poison_request@id=... fires here, at the moment the
+                # request is about to occupy a slot — the journal has
+                # its admit record, so the crash-replay counter (the
+                # poison-pill rule) sees every death it causes
+                self.chaos.on_request(req.id)
             resumed = self._account_pop(req)
             ev = self._admit(req, slot)
             if ev is None:
@@ -885,12 +1115,20 @@ class Engine:
             while True:
                 if should_stop is not None and should_stop():
                     break
+                if self.drain_expired():
+                    # the grace window closed with work still in hand:
+                    # stop NOW — everything unfinished is journaled, so
+                    # the next life replays it instead of losing it
+                    self.tracer.event("drain_timeout", tick=self._tick_no,
+                                      active=self.n_active,
+                                      queue=len(self.queue))
+                    break
                 if self.idle:
                     # drain_when first, idle RE-checked after: a
                     # transport's last submit happens-before its EOF
                     # flag, so this ordering can never strand a request
                     # that raced the drain signal
-                    if drain_when() and self.idle:
+                    if (self._draining or drain_when()) and self.idle:
                         break
                     # same payload shape as the serve beat so a watcher
                     # (obs doctor) reads occupancy whichever phase the

@@ -170,6 +170,15 @@ def run_load(engine, spec: LoadSpec) -> dict:
            for k in ("prefix_hit_rate", "prefill_tokens_saved",
                      "preempted", "cow_copies", "blocks_in_use",
                      "hbm_per_req_mb")},
+        # overload brownout (PR 8): shed/clamp events as rates so
+        # `obs diff` gates them across rounds at any request count
+        "shed": cache.get("shed", 0),
+        "brownout_clamped": cache.get("brownout_clamped", 0),
+        "shed_rate": round(cache.get("shed", 0) / spec.n_requests, 4)
+        if spec.n_requests else 0.0,
+        "clamp_rate": round(
+            cache.get("brownout_clamped", 0) / spec.n_requests, 4)
+        if spec.n_requests else 0.0,
         **attribution,
         "dominant_phase_p99": dominant,
     }

@@ -45,8 +45,14 @@ class ServeMetrics:
                      "serve_timed_out", "serve_completed", "serve_ticks",
                      "serve_prefix_lookups", "serve_prefix_hits",
                      "serve_prefill_tokens_saved", "serve_preempted",
-                     "serve_cow_copies", "serve_blocks_evicted"):
+                     "serve_cow_copies", "serve_blocks_evicted",
+                     # crash-safety + overload (journal/drain/brownout)
+                     "serve_shed", "serve_brownout_clamped",
+                     "serve_replayed", "serve_poisoned",
+                     "serve_journal_errors", "serve_dropped_sinks"):
             self.reg.counter(name)
+        # 0/1 flag, pre-set so "never browned out" snapshots as 0
+        self.reg.gauge("serve_brownout_active").set(0.0)
 
     # -------------------------------------------------- admission edge
 
@@ -111,6 +117,34 @@ class ServeMetrics:
 
     def on_preempt(self) -> None:
         self.reg.counter("serve_preempted").inc()
+
+    # ------------------------------------- crash safety + overload (PR 8)
+
+    def on_shed(self) -> None:
+        """Brownout shed one deadline-doomed queued request."""
+        self.reg.counter("serve_shed").inc()
+
+    def on_clamp(self) -> None:
+        """Brownout clamped a new admission's max_new_tokens."""
+        self.reg.counter("serve_brownout_clamped").inc()
+
+    def set_brownout(self, active: bool) -> None:
+        self.reg.gauge("serve_brownout_active").set(1.0 if active else 0.0)
+
+    def on_replay(self) -> None:
+        """One journaled request re-admitted at recovery."""
+        self.reg.counter("serve_replayed").inc()
+
+    def on_poisoned(self) -> None:
+        """One request quarantined by the crash-replay poison rule."""
+        self.reg.counter("serve_poisoned").inc()
+
+    def on_journal_error(self) -> None:
+        self.reg.counter("serve_journal_errors").inc()
+
+    def on_dropped_sink(self) -> None:
+        """A client died mid-stream; its sink was dropped."""
+        self.reg.counter("serve_dropped_sinks").inc()
 
     def on_cow(self) -> None:
         self.reg.counter("serve_cow_copies").inc()
@@ -201,4 +235,12 @@ class ServeMetrics:
             "blocks_evicted": int(c.get("serve_blocks_evicted", 0)),
             "blocks_in_use": g.get("serve_blocks_in_use"),
             "hbm_per_req_mb": g.get("serve_hbm_per_req_mb"),
+            # crash safety + overload (journal/drain/brownout)
+            "shed": int(c.get("serve_shed", 0)),
+            "brownout_clamped": int(c.get("serve_brownout_clamped", 0)),
+            "brownout_active": bool(g.get("serve_brownout_active", 0.0)),
+            "replayed": int(c.get("serve_replayed", 0)),
+            "poisoned": int(c.get("serve_poisoned", 0)),
+            "journal_errors": int(c.get("serve_journal_errors", 0)),
+            "dropped_sinks": int(c.get("serve_dropped_sinks", 0)),
         }

@@ -46,6 +46,9 @@ _ids = itertools.count()
 REJECT_QUEUE_FULL = "queue_full"
 REJECT_TOO_LONG = "too_long"
 REJECT_BAD_REQUEST = "bad_request"
+REJECT_DRAINING = "draining"        # queue closed for graceful shutdown
+REJECT_SHED = "shed_deadline"       # brownout: deadline unmeetable now
+REJECT_POISONED = "request_poisoned"  # crash-replay quarantine
 TIMED_OUT = "timed_out"
 
 
@@ -104,6 +107,10 @@ class Request:
     preempts: int = 0
     finish_reason: str | None = None   # eos|budget|rejected|timed_out
     _preempted: bool = False           # next pop is a replay resume
+    # --- crash-safety bookkeeping (serve/journal.py) ---
+    replays: int = 0                   # journal crash-replay count
+    _journaled: bool = False           # has an admit record on the WAL
+    clamped_from: int | None = None    # brownout clamp: original max_new
 
     def __post_init__(self):
         self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
@@ -162,6 +169,7 @@ class AdmissionQueue:
         self.prefill_budget = max(1, prefill_budget)
         self._q: deque[Request] = deque()
         self._lock = threading.Lock()
+        self._closed: str | None = None  # reject reason once closed
 
     # ------------------------------------------------------------ admit
 
@@ -174,6 +182,12 @@ class AdmissionQueue:
         # clock — TTFT/e2e/deadline/queue_wait — starts at the door,
         # else pre-submit idle time masquerades as queue wait
         req.submitted_at = req.enqueued_at = time.monotonic()
+        if self._closed is not None:
+            # graceful drain: the door is shut, in-flight work finishes.
+            # Checked first — a draining server's answer is "go away",
+            # not a validation report.
+            req.status = "rejected"
+            return False, self._closed
         if req.max_new_tokens < 1:
             req.status = "rejected"
             return False, REJECT_BAD_REQUEST
@@ -253,6 +267,43 @@ class AdmissionQueue:
         with self._lock:
             self._q.appendleft(req)
 
+    def close(self, reason: str = REJECT_DRAINING) -> None:
+        """Shut the door: every later `submit` rejects with `reason`.
+        Requests already queued are unaffected — drain means finishing
+        what was accepted, not abandoning it."""
+        with self._lock:
+            self._closed = reason
+
+    @property
+    def closed(self) -> bool:
+        return self._closed is not None
+
+    def shed_doomed(self, now: float | None = None,
+                    est_wait_s: float = 0.0) -> list[Request]:
+        """Brownout shedding, deadline-aware: remove queued requests
+        whose deadline cannot be met even if service began after the
+        current estimated wait (`deadline < now + est_wait_s`). These
+        are the CHEAPEST requests to shed — they are already doomed, so
+        rejecting them now costs the client a fast retry signal instead
+        of a slow guaranteed timeout, and frees queue positions for
+        requests that can still win. Returned soonest-deadline first
+        (most-doomed first); requests without deadlines are never shed
+        here — with no SLO stated, the queue cannot call them hopeless."""
+        now = time.monotonic() if now is None else now
+        shed: list[Request] = []
+        with self._lock:
+            alive: deque[Request] = deque()
+            for r in self._q:
+                dl = r.deadline_at
+                if dl is not None and dl < now + est_wait_s:
+                    r.status = "rejected"
+                    shed.append(r)
+                else:
+                    alive.append(r)
+            self._q = alive
+        shed.sort(key=lambda r: r.deadline_at)
+        return shed
+
     def drop_expired(self, now: float | None = None) -> list[Request]:
         """Sweep expired requests without admitting (used while all
         slots are busy so waiting requests still time out on time)."""
@@ -277,3 +328,70 @@ class AdmissionQueue:
     @property
     def depth(self) -> int:
         return len(self)
+
+
+class BrownoutGovernor:
+    """Hysteretic overload detector — the state machine behind
+    `--brownout`.
+
+    Overload has two observable signatures at the queue: depth growing
+    (arrivals outpace drains) and queue-wait p95 growing (the user-felt
+    version of the same fact, which also catches a slow engine at
+    constant depth). The governor watches both and flips `active` with
+    **hysteresis** — enter at the high watermarks, exit only when BOTH
+    signals are back under the low ones — so a load hovering at the
+    threshold browns out once, not every other tick (flapping would
+    turn the clamp into output-length jitter and the shed into a
+    lottery).
+
+    Host-only and engine-agnostic on purpose: `update()` takes numbers
+    and returns a transition, so the hysteresis contract is unit-
+    testable without a model, a device, or a clock."""
+
+    def __init__(self, *, depth_high: int, depth_low: int | None = None,
+                 wait_high_s: float = 0.0, wait_low_s: float | None = None,
+                 window: int = 64):
+        if depth_high < 1 and wait_high_s <= 0:
+            raise ValueError("brownout needs a depth or wait watermark")
+        self.depth_high = depth_high
+        self.depth_low = depth_low if depth_low is not None \
+            else max(0, depth_high // 2)
+        self.wait_high_s = wait_high_s
+        self.wait_low_s = wait_low_s if wait_low_s is not None \
+            else wait_high_s / 2.0
+        self._waits: deque[float] = deque(maxlen=max(4, window))
+        self.active = False
+
+    def observe_wait(self, wait_s: float) -> None:
+        """Feed one completed queue wait (the engine calls this at each
+        pop — the only moment a wait's true length is known)."""
+        self._waits.append(float(wait_s))
+
+    def wait_p95(self) -> float:
+        if not self._waits:
+            return 0.0
+        from hyperion_tpu.obs.registry import percentile
+
+        return float(percentile(list(self._waits), 95))
+
+    def update(self, depth: int) -> str | None:
+        """Advance the state machine; returns "enter"/"exit" on a
+        transition, None otherwise."""
+        p95 = self.wait_p95()
+        if not self.active:
+            over = (self.depth_high > 0 and depth >= self.depth_high) or \
+                (self.wait_high_s > 0 and p95 >= self.wait_high_s)
+            if over:
+                self.active = True
+                return "enter"
+            return None
+        under = (self.depth_high <= 0 or depth <= self.depth_low) and \
+            (self.wait_high_s <= 0 or p95 <= self.wait_low_s)
+        if under:
+            self.active = False
+            # the waits that tripped the watermark are history the
+            # moment we recover — keeping them would re-trip the next
+            # update from stale evidence
+            self._waits.clear()
+            return "exit"
+        return None

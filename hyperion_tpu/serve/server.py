@@ -29,6 +29,15 @@ channels via per-request sinks. Telemetry rides the same opt-in
 HYPERION_TELEMETRY stream as every other entry point, with `serve`
 phase heartbeats so `obs doctor` can tell a hung server from a
 drained one.
+
+Crash safety (SERVING.md "Crash recovery and drain"): `--journal`
+write-ahead-logs every admission and token so a restart replays
+unfinished requests bit-identically; `--supervise` wraps the server in
+the shared restart core (journal replay + poison-pill quarantine +
+heartbeat hang detection), logging to stderr because stdout IS the
+wire; SIGTERM/SIGINT drain gracefully under `--drain-timeout`; and
+`--brownout` sheds deadline-doomed queued work / clamps budgets under
+overload instead of collapsing.
 """
 
 from __future__ import annotations
@@ -43,6 +52,11 @@ import time
 def event_record(ev, tok=None) -> dict:
     """TokenEvent -> one wire record."""
     req = ev.request
+    if ev.kind == "done":
+        # journal recovery found the output already complete: the
+        # client is owed only the terminal line the crash swallowed
+        return {"id": req.id, "event": "done",
+                "n_tokens": len(req.tokens), "recovered": True}
     if ev.kind != "token":
         return {"id": req.id, "event": ev.kind, "reason": ev.reason}
     rec: dict = {"id": req.id, "event": "token", "token": ev.token}
@@ -127,62 +141,133 @@ class _LineWriter:
 
 
 def serve_jsonl(engine, infile, outfile, tok=None,
-                defaults: dict | None = None) -> dict:
+                defaults: dict | None = None,
+                drain=None, drain_timeout_s: float = 30.0,
+                hard_stop=None) -> dict:
     """stdin/stdout (or any file-pair) mode: a reader thread feeds the
-    queue; the engine loop drains on EOF. Returns the engine summary."""
+    queue; the engine loop drains on EOF. `drain` (a threading.Event)
+    is the graceful-shutdown signal — SIGTERM/SIGINT set it in `main`
+    — flipping the engine to draining (queue closed, in-flight work
+    finishes under `drain_timeout_s`); `hard_stop` aborts immediately
+    (second signal). Returns the engine summary."""
     out = _LineWriter(outfile)
     eof = threading.Event()
 
     def sink(ev):
         out.write(event_record(ev, tok))
 
+    # journal recovery first: requests a previous life owed resume at
+    # the head of the queue, streaming to the same stdout the crashed
+    # process was using (the supervisor shares the pipe across
+    # restarts, so the client sees one continuous stream)
+    engine.replay_pending(sink)
+
     def reader():
         try:
             for line in infile:
-                line = line.strip()
-                if not line:
-                    continue
-                parsed = parse_request_line(line, tok, defaults)
-                if isinstance(parsed, dict):  # error record
-                    out.write(parsed)
-                    continue
-                parsed.sink = sink
-                engine.submit(parsed)
+                try:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    parsed = parse_request_line(line, tok, defaults)
+                    if isinstance(parsed, dict):  # error record
+                        engine.reject_unparsed(parsed.get("id"),
+                                               parsed.get("error") or "")
+                        out.write(parsed)
+                        continue
+                    parsed.sink = sink
+                    engine.submit(parsed)
+                except Exception as e:  # noqa: BLE001
+                    # nothing a client sends (or a dead stdout raises
+                    # back) may kill the reader — and certainly never
+                    # the engine thread, which this loop never touches
+                    engine.reject_unparsed(None, repr(e))
         finally:
             eof.set()
 
+    def should_stop():
+        if drain is not None and drain.is_set():
+            engine.begin_drain(drain_timeout_s)  # idempotent
+        return hard_stop is not None and hard_stop.is_set()
+
     t = threading.Thread(target=reader, name="serve-stdin", daemon=True)
     t.start()
-    summary = engine.run(drain_when=eof.is_set)
+    summary = engine.run(should_stop=should_stop, drain_when=eof.is_set)
     t.join(timeout=5)
     return summary
 
 
+def prepare_socket_path(socket_path: str) -> None:
+    """Make `socket_path` bindable: a socket file that survived a
+    crash (SIGKILL unlinks nothing) would fail the bind forever — the
+    exact restart loop the serve supervisor runs. Probe it first: a
+    connection REFUSED means no listener owns it (stale — unlink); a
+    successful connect means a live server does (refuse loudly instead
+    of yanking a working deployment's socket out from under it)."""
+    import os
+    import socket as socket_mod
+
+    if not os.path.exists(socket_path):
+        return
+    probe = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    probe.settimeout(0.25)
+    try:
+        probe.connect(socket_path)
+    except OSError:
+        try:
+            os.unlink(socket_path)
+        except OSError:
+            pass
+    else:
+        raise RuntimeError(
+            f"socket {socket_path} is owned by a live server — refusing "
+            "to steal it (stop the other process or pick another path)")
+    finally:
+        probe.close()
+
+
 def serve_socket(engine, socket_path: str, tok=None,
                  defaults: dict | None = None,
-                 should_stop=None, ready=None) -> dict:
+                 should_stop=None, ready=None,
+                 drain=None, drain_timeout_s: float = 30.0,
+                 hard_stop=None) -> dict:
     """Unix-socket mode: threaded acceptor submits, engine loop (this
     thread) decodes. Each connection gets exactly its own requests'
     events. `ready` (an optional threading.Event) is set once the
-    socket is listening — tests wait on it instead of polling."""
+    socket is listening — tests wait on it instead of polling. `drain`
+    flips graceful shutdown like the stdin transport; journal-replayed
+    requests have no surviving connection, so their continuations run
+    sink-less (the journal still records them — a reconnecting client
+    re-submits and hits the radix cache)."""
     import os
     import socketserver
+
+    engine.replay_pending(None)
 
     class Handler(socketserver.StreamRequestHandler):
         def handle(self):
             writer = _LineWriter(self.wfile)
             pending: list = []
             for raw in self.rfile:
-                line = raw.decode("utf-8", "replace").strip()
-                if not line:
-                    continue
-                parsed = parse_request_line(line, tok, defaults)
-                if isinstance(parsed, dict):
-                    writer.write(parsed)
-                    continue
-                parsed.sink = lambda ev: writer.write(event_record(ev, tok))
-                pending.append(parsed)
-                engine.submit(parsed)
+                try:
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line:
+                        continue
+                    parsed = parse_request_line(line, tok, defaults)
+                    if isinstance(parsed, dict):
+                        engine.reject_unparsed(parsed.get("id"),
+                                               parsed.get("error") or "")
+                        writer.write(parsed)
+                        continue
+                    parsed.sink = lambda ev: writer.write(
+                        event_record(ev, tok))
+                    pending.append(parsed)
+                    engine.submit(parsed)
+                except Exception as e:  # noqa: BLE001 — a hostile or
+                    # half-dead connection is its own problem, never
+                    # the engine's
+                    engine.reject_unparsed(None, repr(e))
+                    break
             for req in pending:  # connection half-closed: finish streams
                 req.done.wait(timeout=600)
 
@@ -191,22 +276,33 @@ def serve_socket(engine, socket_path: str, tok=None,
         daemon_threads = True
         allow_reuse_address = True
 
-    try:
-        os.unlink(socket_path)
-    except OSError:
-        pass
+        def handle_error(self, request, client_address):
+            # a client that died mid-handshake/stream: evidence, not a
+            # stack trace on stderr and never a server death
+            engine.tracer.event("client_error",
+                                client=str(client_address))
+
+    prepare_socket_path(socket_path)
     srv = Server(socket_path, Handler)
     acceptor = threading.Thread(target=srv.serve_forever,
                                 name="serve-accept", daemon=True)
     acceptor.start()
     if ready is not None:
         ready.set()
+
+    def _stop():
+        if drain is not None and drain.is_set():
+            engine.begin_drain(drain_timeout_s)  # idempotent
+        if hard_stop is not None and hard_stop.is_set():
+            return True  # second signal: stop now, journal holds the rest
+        return bool(should_stop and should_stop())
+
     try:
         summary = engine.run(
-            should_stop=should_stop,
+            should_stop=_stop,
             # a socket server idles between connections; only an
-            # explicit stop drains it
-            drain_when=(should_stop or (lambda: False)),
+            # explicit stop (or the drain signal) drains it
+            drain_when=lambda: bool(should_stop and should_stop()),
         )
     finally:
         srv.shutdown()
@@ -283,18 +379,155 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--chaos", default="",
                    help="deterministic fault plan (testing/chaos.py): "
                         "stall@tick=N:SECS, slow_client@tick=N:SECS, "
-                        "kill@tick=N, ... — serve-loop drills")
+                        "kill@tick=N, crash@tick=N, journal_io_fail@p=X, "
+                        "poison_request@id=ID, ... — serve-loop drills "
+                        "(tick faults fire once per supervisor lineage)")
+    # ---- crash safety: journal + supervised restarts + drain ----
+    p.add_argument("--journal", default="", metavar="PATH",
+                   help="append-only request journal (JSONL WAL): every "
+                        "admission and emitted token is recorded so a "
+                        "crashed engine's restart REPLAYS unfinished "
+                        "requests to bit-identical completion "
+                        "(serve/journal.py); --supervise defaults this "
+                        "to data/serve_journal.jsonl")
+    p.add_argument("--supervise", action="store_true",
+                   help="run the server as a supervised subprocess: on "
+                        "a crash, consult `obs doctor`, restart with "
+                        "backoff, and replay the request journal; a "
+                        "request that crashes the engine repeatedly is "
+                        "quarantined (request_poisoned) instead of "
+                        "crash-looping")
+    p.add_argument("--max-restarts", type=int, default=2,
+                   help="--supervise: restarts before giving up with "
+                        "exit 3")
+    p.add_argument("--hang-timeout", type=float, default=120.0,
+                   help="--supervise: SIGKILL a child whose heartbeat "
+                        "goes stale this many seconds (0 = off; needs "
+                        "telemetry for the heartbeat file)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="SIGTERM/SIGINT: seconds granted to in-flight "
+                        "and already-queued requests before a hard "
+                        "stop; new submissions reject with reason "
+                        "'draining' immediately (a second signal stops "
+                        "now). A fully drained journal is marked clean "
+                        "— the next start replays nothing")
+    # ---- overload brownout ----
+    p.add_argument("--brownout", action="store_true",
+                   help="degrade gracefully under overload: when queue "
+                        "depth or queue-wait p95 crosses its watermark, "
+                        "shed queued requests whose deadline is already "
+                        "unmeetable (reject reason 'shed_deadline') and "
+                        "optionally clamp max_new_tokens for new "
+                        "admissions; exits with hysteresis at half the "
+                        "watermark so it never flaps")
+    p.add_argument("--brownout-depth", type=int, default=0,
+                   help="queue-depth enter watermark (0 = 3/4 of "
+                        "--queue-capacity); exit at half of it")
+    p.add_argument("--brownout-wait-s", type=float, default=0.0,
+                   help="queue-wait p95 enter watermark in seconds "
+                        "(0 = depth watermark only)")
+    p.add_argument("--brownout-clamp", type=int, default=0,
+                   help="while browned out, clamp each new admission's "
+                        "max_new_tokens to this (0 = shed only); "
+                        "recorded on the journal so replays honor it")
     return p
 
 
+DEFAULT_JOURNAL = "data/serve_journal.jsonl"
+
+
+def _strip_supervise_flags(argv: list[str]) -> list[str]:
+    from hyperion_tpu.supervisor import strip_flags
+
+    return strip_flags(argv, {"--supervise"},
+                       {"--max-restarts", "--hang-timeout"})
+
+
+def _env_telemetry_path() -> str | None:
+    """The stream path the CHILD's `from_env` will resolve — computed
+    jax-free so the supervisor parent can find the heartbeat file and
+    the doctor's run dir without importing the serving stack."""
+    import os
+
+    val = os.environ.get("HYPERION_TELEMETRY", "")
+    if val in ("", "0"):
+        return None
+    return "data/telemetry.jsonl" if val == "1" else val
+
+
+def supervise_serve(argv: list[str], args) -> int:
+    """`hyperion serve --supervise`: the crash loop around the serving
+    child — the shared supervisor core (hyperion_tpu/supervisor.py)
+    with the serve policy: any crash restarts with backoff (the child
+    replays its request journal on the way up), a heartbeat gone stale
+    past --hang-timeout gets the child SIGKILLed (a wedged engine never
+    exits by itself), and `obs doctor` is consulted for the verdict the
+    operator reads. The parent never touches jax — it must stay alive
+    when the child is wedged inside a dead backend."""
+    from pathlib import Path
+
+    from hyperion_tpu.supervisor import (
+        Decision,
+        heartbeat_watchdog,
+        run_child,
+        supervise_loop,
+    )
+
+    def log(msg: str) -> None:
+        # stderr, always: the children's stdout is the client's JSONL
+        # wire stream and must never carry supervisor chatter
+        print(msg, file=sys.stderr, flush=True)
+
+    tele = _env_telemetry_path()
+    hb_path = str(Path(tele).parent / "heartbeat.json") if tele else None
+    runner = run_child
+    if args.hang_timeout > 0 and hb_path:
+        runner = heartbeat_watchdog(hb_path, args.hang_timeout, log=log)
+
+    def decide(rc: int) -> Decision:
+        verdict = None
+        if tele is not None:
+            try:
+                from hyperion_tpu.obs.doctor import diagnose
+
+                # the stream file itself, not its directory: the env
+                # var may name anything, not just telemetry.jsonl
+                verdict = diagnose(tele).get("verdict")
+            except Exception as e:  # noqa: BLE001 — triage is advisory
+                log(f"[serve-supervisor] doctor consult failed: {e}")
+        log(f"[serve-supervisor] child exit {rc}; doctor verdict: "
+            f"{verdict or 'unavailable'}; restarting with journal "
+            "replay")
+        return Decision.restart()
+
+    child_argv = _strip_supervise_flags(argv)
+    if "--journal" not in " ".join(child_argv):
+        # replay is the whole point of a supervised restart: default
+        # the WAL on and pin the path so every child shares it
+        child_argv += ["--journal", args.journal or DEFAULT_JOURNAL]
+    child = [sys.executable, "-m", "hyperion_tpu.cli.main", "serve",
+             *child_argv]
+    return supervise_loop(child, decide=decide,
+                          max_restarts=args.max_restarts,
+                          run_child=runner, label="serve-supervisor",
+                          log=log)
+
+
 def main(argv=None) -> int:
+    import os
+    import signal
+
+    argv = sys.argv[1:] if argv is None else list(argv)
     args = build_parser().parse_args(argv)
+    if args.supervise:
+        return supervise_serve(argv, args)
 
     from hyperion_tpu.checkpoint.io import load_gathered
     from hyperion_tpu.infer.generate import model_from_npz
     from hyperion_tpu.obs import heartbeat as obs_heartbeat
     from hyperion_tpu.obs import trace as obs_trace
     from hyperion_tpu.serve.engine import Engine, EngineConfig
+    from hyperion_tpu.serve.journal import RequestJournal
 
     tok = None
     if not args.no_tokenizer:
@@ -302,16 +535,36 @@ def main(argv=None) -> int:
 
         tok = ByteBPE.load(args.tokenizer_dir)
 
+    attempt = int(os.environ.get("HYPERION_ATTEMPT", "0") or 0)
     tracer = obs_trace.from_env(
         "data/telemetry.jsonl", run=f"serve_{int(time.time())}")
-    hb = obs_heartbeat.Heartbeat.for_tracer(tracer,
-                                            every=args.heartbeat_every)
+    hb = obs_heartbeat.Heartbeat.for_tracer(
+        tracer, every=args.heartbeat_every,
+        static={"attempt": attempt})
     hb.pulse(phase="load")
+    journal = None
     chaos = None
     if args.chaos:
         from hyperion_tpu.testing import chaos as chaos_mod
+        from pathlib import Path
 
-        chaos = chaos_mod.activate(args.chaos)
+        # state file next to the journal (or the stream): tick faults
+        # fire once per supervisor LINEAGE, so a restarted child does
+        # not re-die at the already-fired tick — the same contract the
+        # trainer drills rely on
+        state_dir = None
+        if args.journal:
+            state_dir = Path(args.journal).parent
+        elif _env_telemetry_path():
+            state_dir = Path(_env_telemetry_path()).parent
+        chaos = chaos_mod.activate(
+            args.chaos,
+            state_path=(state_dir / "serve_chaos_state.json"
+                        if state_dir is not None else None))
+    if args.journal:
+        journal = RequestJournal(
+            args.journal,
+            fault=chaos.journal_io if chaos is not None else None)
 
     with tracer.span("load") as ld:
         params = load_gathered(args.ckpt)
@@ -335,12 +588,37 @@ def main(argv=None) -> int:
             prefill_budget=args.prefill_budget,
             block_size=args.block_size, num_blocks=args.num_blocks,
             prefix_cache=args.prefix_cache,
+            brownout=args.brownout,
+            brownout_depth=args.brownout_depth,
+            brownout_wait_s=args.brownout_wait_s,
+            brownout_clamp=args.brownout_clamp,
         ),
-        tracer=tracer, heartbeat=hb, chaos=chaos,
+        tracer=tracer, heartbeat=hb, chaos=chaos, journal=journal,
     )
     hb.pulse(phase="warmup")
     warm = [int(x) for x in args.warmup_lens.split(",") if x.strip()]
     engine.warmup(warm or None)
+
+    # graceful drain: first SIGTERM/SIGINT closes the queue and lets
+    # in-flight work finish under --drain-timeout; a second one stops
+    # hard (unfinished work stays journaled for the next life)
+    drain_evt = threading.Event()
+    hard_evt = threading.Event()
+
+    def _on_signal(signum, frame):
+        if drain_evt.is_set():
+            hard_evt.set()
+        else:
+            print(f"[serve] signal {signum}: draining (timeout "
+                  f"{args.drain_timeout:.0f}s; signal again to stop "
+                  "now)", file=sys.stderr)
+        drain_evt.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_signal)
+        except ValueError:
+            pass  # not the main thread (embedded use): no signal drain
 
     defaults = {"max_new_tokens": args.max_new_default}
     try:
@@ -348,12 +626,28 @@ def main(argv=None) -> int:
             print(f"[serve] listening on {args.socket} "
                   f"({args.slots} slots, max_len {args.max_len})",
                   file=sys.stderr)
-            serve_socket(engine, args.socket, tok, defaults)
+            serve_socket(engine, args.socket, tok, defaults,
+                         drain=drain_evt,
+                         drain_timeout_s=args.drain_timeout,
+                         hard_stop=hard_evt)
         else:
-            serve_jsonl(engine, sys.stdin, sys.stdout, tok, defaults)
+            serve_jsonl(engine, sys.stdin, sys.stdout, tok, defaults,
+                        drain=drain_evt,
+                        drain_timeout_s=args.drain_timeout,
+                        hard_stop=hard_evt)
     except KeyboardInterrupt:
         pass
     finally:
+        if journal is not None:
+            if engine.idle:
+                # fully drained: mark the WAL clean so the next start
+                # replays nothing — the drain-exits-0 contract
+                journal.close_clean()
+            else:
+                journal.close()
+                print(f"[serve] {len(engine.queue) + engine.n_active} "
+                      "request(s) still owed — journaled for replay at "
+                      "the next start", file=sys.stderr)
         tracer.close()
         if tracer.enabled:
             # every request's lifecycle (queue/gate/prefill/decode/

@@ -26,6 +26,20 @@ or `HYPERION_CHAOS`:
                          draining (dead socket, wedged pipe) and
                          backpressures the serve loop from the client
                          side rather than the device side
+    crash@tick=N         hard `os._exit` before serve tick N — no
+                         signal handlers, no atexit, no flushes beyond
+                         what already hit the kernel: the ugliest
+                         process death the journal replay must survive
+    journal_io_fail@p=X  raise OSError with probability X inside the
+                         request journal's append path
+                         (serve/journal.py) — durability must degrade,
+                         never kill the serve loop
+    poison_request@id=ID SIGKILL the process every time request ID is
+                         about to occupy a slot — the adversarial
+                         request the poison-pill replay rule exists
+                         for (fires EVERY time, exempt from the
+                         once-per-lineage record: re-crashing on replay
+                         is the point)
     corrupt_ckpt@latest  at activation, corrupt the newest existing
                          checkpoint (truncate its largest payload file)
                          — the partial-save artifact restore must skip
@@ -66,28 +80,33 @@ ENV_VAR = "HYPERION_CHAOS"
 
 _STEP_CLAUSE = re.compile(r"^(kill|sigterm|nan_loss|stall)@step=(\d+)(?::([0-9.]+))?$")
 _TICK_CLAUSE = re.compile(
-    r"^(kill|sigterm|stall|slow_client)@tick=(\d+)(?::([0-9.]+))?$")
+    r"^(kill|sigterm|stall|slow_client|crash)@tick=(\d+)(?::([0-9.]+))?$")
 _CKPT_CLAUSE = re.compile(r"^corrupt_ckpt@latest$")
 _IO_CLAUSE = re.compile(r"^io_fail@p=([0-9.]+)$")
+_JOURNAL_CLAUSE = re.compile(r"^journal_io_fail@p=([0-9.]+)$")
+_POISON_CLAUSE = re.compile(r"^poison_request@id=([\w.:-]+)$")
 
 
 @dataclasses.dataclass(frozen=True)
 class Fault:
-    kind: str                 # kill | sigterm | nan_loss | stall | slow_client | corrupt_ckpt | io_fail
+    kind: str                 # kill | sigterm | nan_loss | stall | slow_client | crash | corrupt_ckpt | io_fail | journal_io_fail | poison_request
     step: int | None = None   # trainer step OR serve tick, per `unit`
     secs: float = 0.0         # stall / slow_client duration
-    p: float = 0.0            # io_fail probability
+    p: float = 0.0            # io_fail / journal_io_fail probability
     unit: str = "step"        # "step" (trainer loop) | "tick" (serve loop)
+    rid: str | None = None    # poison_request target id
 
     @property
     def key(self) -> str:
         """Canonical id for the one-shot fire record."""
         if self.kind in ("stall", "slow_client"):
             return f"{self.kind}@{self.unit}={self.step}:{self.secs}"
-        if self.kind == "io_fail":
-            return f"io_fail@p={self.p}"
+        if self.kind in ("io_fail", "journal_io_fail"):
+            return f"{self.kind}@p={self.p}"
         if self.kind == "corrupt_ckpt":
             return "corrupt_ckpt@latest"
+        if self.kind == "poison_request":
+            return f"poison_request@id={self.rid}"
         return f"{self.kind}@{self.unit}={self.step}"
 
 
@@ -120,13 +139,21 @@ def parse_plan(spec: str) -> list[Fault]:
             if not 0.0 <= p <= 1.0:
                 raise ValueError(f"chaos clause {clause!r}: p outside [0,1]")
             faults.append(Fault("io_fail", p=p))
+        elif m := _JOURNAL_CLAUSE.match(clause):
+            p = float(m.group(1))
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"chaos clause {clause!r}: p outside [0,1]")
+            faults.append(Fault("journal_io_fail", p=p))
+        elif m := _POISON_CLAUSE.match(clause):
+            faults.append(Fault("poison_request", rid=m.group(1)))
         else:
             raise ValueError(
                 f"unknown chaos clause {clause!r} (grammar: kill@step=N, "
                 "sigterm@step=N, nan_loss@step=N, stall@step=N:SECS, "
                 "kill@tick=N, sigterm@tick=N, stall@tick=N:SECS, "
-                "slow_client@tick=N:SECS, corrupt_ckpt@latest, "
-                "io_fail@p=X)")
+                "slow_client@tick=N:SECS, crash@tick=N, "
+                "journal_io_fail@p=X, poison_request@id=ID, "
+                "corrupt_ckpt@latest, io_fail@p=X)")
     return faults
 
 
@@ -142,6 +169,7 @@ class ChaosPlan:
         self.faults = list(faults)
         self.state_path = Path(state_path) if state_path else None
         self._rng = np.random.default_rng(seed)
+        self._jrng = np.random.default_rng(seed + 1)  # journal_io_fail
         self._fired: set[str] = set()
         if self.state_path is not None and self.state_path.exists():
             try:
@@ -210,13 +238,19 @@ class ChaosPlan:
         which is the exact signature `obs doctor` classifies as hung."""
         for f in self.faults:
             if f.unit != "tick" or f.step != tick \
-                    or f.kind not in ("kill", "sigterm", "stall"):
+                    or f.kind not in ("kill", "sigterm", "stall", "crash"):
                 continue
             if not self._mark(f):
                 continue
             print(f"[chaos] firing {f.key}", flush=True)
             if f.kind == "kill":
                 os.kill(os.getpid(), signal.SIGKILL)
+            elif f.kind == "crash":
+                # os._exit: no handlers, no atexit, no tracer flush —
+                # only bytes already written to the kernel survive,
+                # which is exactly the durability bar the request
+                # journal claims to meet
+                os._exit(70)
             elif f.kind == "sigterm":
                 os.kill(os.getpid(), signal.SIGTERM)
             elif f.kind == "stall":
@@ -232,6 +266,26 @@ class ChaosPlan:
                     and f.step == tick and self._mark(f):
                 print(f"[chaos] firing {f.key}", flush=True)
                 time.sleep(f.secs)
+
+    def on_request(self, request_id: str) -> None:
+        """poison_request@id=ID — fired by the serve engine when the
+        request is about to occupy a slot. Deliberately EXEMPT from the
+        fire record: the poison pill is defined by crashing again on
+        every replay, and the defense under test is the journal's
+        replay counter, not the chaos bookkeeping."""
+        for f in self.faults:
+            if f.kind == "poison_request" and f.rid == request_id:
+                print(f"[chaos] firing {f.key}", flush=True)
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    def journal_io(self, tag: str) -> None:
+        """journal_io_fail@p=X — the request journal's append-path
+        injector (its own seeded RNG, so adding a journal plan never
+        shifts the io_fail@p sequence checkpoint tests pinned)."""
+        for f in self.faults:
+            if f.kind == "journal_io_fail" and f.p > 0.0 \
+                    and self._jrng.random() < f.p:
+                raise OSError(f"[chaos] injected journal_io_fail at {tag!r}")
 
     def poison_loss(self, step: int, loss: float) -> float:
         """nan_loss@step=N: the value the HealthMonitor sees at step N

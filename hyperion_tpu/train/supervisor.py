@@ -37,23 +37,25 @@ responsive when the child is wedged inside a dead backend.
 
 from __future__ import annotations
 
-import os
-import random
-import subprocess
 import time
 from pathlib import Path
 
-EXIT_OK = 0
-EXIT_USAGE = 2
-EXIT_GAVE_UP = 3
-EXIT_HEALTH_ABORT = 4   # trainer: health policy aborted (diverged)
-EXIT_PREEMPTED = 75     # trainer: clean preemption checkpoint, resumable
-
-ATTEMPT_ENV = "HYPERION_ATTEMPT"
-
-
-def _run_child(argv: list[str], env: dict) -> int:
-    return subprocess.call(argv, env=env)
+# The restart loop itself (attempt stamping, backoff, budget, give-up)
+# is the shared core `hyperion_tpu/supervisor.py` — the serve
+# supervisor (serve/server.py) runs the same loop with its own policy.
+# This module keeps the TRAINING policy: doctor triage, divergence
+# quarantine, and the free-restart rule for progressing preemptions.
+from hyperion_tpu.supervisor import (  # noqa: F401 — re-exported API
+    ATTEMPT_ENV,
+    EXIT_GAVE_UP,
+    EXIT_HEALTH_ABORT,
+    EXIT_OK,
+    EXIT_PREEMPTED,
+    EXIT_USAGE,
+    Decision,
+    run_child as _run_child,
+    supervise_loop,
+)
 
 
 def _consult_doctor(base_dir: str | Path,
@@ -146,24 +148,9 @@ def supervise(
 ) -> int:
     """Run `child_argv` under restart supervision. `run_child`/`sleep`
     are injectable for tests."""
-    rng = random.Random(0)
-    restarts = 0
-    attempt = 0
-    prev_step: int | None = None
-    while True:
-        env = {**os.environ, ATTEMPT_ENV: str(attempt)}
-        print(f"[supervisor] attempt {attempt}: {' '.join(child_argv)}",
-              flush=True)
-        rc = run_child(child_argv, env)
-        if rc == EXIT_OK:
-            if attempt:
-                print(f"[supervisor] run completed after {attempt} "
-                      "restart(s)")
-            return EXIT_OK
-        if rc == EXIT_USAGE:
-            print("[supervisor] usage error (exit 2); not restarting")
-            return rc
+    prev_step: list[int | None] = [None]  # closure cell for progress
 
+    def decide(rc: int) -> Decision:
         diag = _consult_doctor(base_dir,
                                prefer_diverged=rc == EXIT_HEALTH_ABORT)
         verdict = diag.get("verdict") if diag else None
@@ -180,9 +167,8 @@ def supervise(
         # no telemetry to prove progress) still burns budget.
         cur_step = diag.get("last_step") if diag else None
         progressed = (cur_step is not None
-                      and (prev_step is None or cur_step > prev_step))
-        prev_step = cur_step if cur_step is not None else prev_step
-        free_restart = rc == EXIT_PREEMPTED and progressed
+                      and (prev_step[0] is None or cur_step > prev_step[0]))
+        prev_step[0] = cur_step if cur_step is not None else prev_step[0]
 
         if diverged:
             # quarantine even when about to give up: whoever reruns by
@@ -198,20 +184,14 @@ def supervise(
             print(f"[supervisor] diverged: quarantined "
                   f"{q.name if q else 'nothing (no checkpoints yet)'}")
 
-        if not free_restart and restarts >= max_restarts:
-            print(f"[supervisor] giving up after {restarts} restart(s) "
-                  f"(--max-restarts {max_restarts}); last exit {rc}")
-            return EXIT_GAVE_UP
+        # immediate: the capacity event is over; the checkpoint waits
+        return Decision.restart(
+            free=rc == EXIT_PREEMPTED and progressed,
+            immediate=rc == EXIT_PREEMPTED,
+        )
 
-        if not free_restart:
-            restarts += 1
-        attempt += 1
-        if rc == EXIT_PREEMPTED:
-            delay = 0.0  # the capacity event is over; the checkpoint waits
-        else:
-            delay = min(backoff_s * (2.0 ** (restarts - 1)), max_backoff_s)
-            delay *= 1.0 + rng.uniform(-0.25, 0.25)
-        if delay:
-            print(f"[supervisor] restarting in {delay:.1f}s "
-                  f"(restart {restarts}/{max_restarts})")
-            sleep(delay)
+    return supervise_loop(
+        child_argv, decide=decide, max_restarts=max_restarts,
+        backoff_s=backoff_s, max_backoff_s=max_backoff_s,
+        run_child=run_child, sleep=sleep,
+    )

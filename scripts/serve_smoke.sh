@@ -123,3 +123,63 @@ assert "Tail attribution" in md and "dominant" in md
 print(f"[serve_smoke] OK: obs trace — {len(evs)} trace events, "
       f"{len(reqs)} request rows, attribution table rendered")
 PY
+
+# 6. kill-and-resume round trip: a supervised server crashes HARD
+#    (chaos crash@tick=2 is os._exit — no handlers, no flushes) mid-
+#    decode; the supervisor restarts it and the request journal replays
+#    the in-flight request. The client — this script's single stdout
+#    capture across both process lives — receives the complete
+#    continuation exactly once, bit-identical to an uninterrupted run.
+KILLREQ='{"id":"k1","prompt_ids":[3,4,5,6,7,8],"max_new_tokens":10}'
+
+printf '%s\n' "$KILLREQ" \
+  | python -m hyperion_tpu.cli.main serve \
+      --ckpt "$WORK/llama.npz" --no-tokenizer \
+      --max-len 64 --slots 2 --warmup-lens 8,32 \
+      > "$WORK/ref_responses.jsonl"
+
+printf '%s\n' "$KILLREQ" \
+  | env HYPERION_TELEMETRY="$WORK/kill_tele.jsonl" \
+    python -m hyperion_tpu.cli.main serve \
+      --ckpt "$WORK/llama.npz" --no-tokenizer \
+      --max-len 64 --slots 2 --warmup-lens 8,32 \
+      --journal "$WORK/kill_journal.jsonl" \
+      --supervise --max-restarts 2 --hang-timeout 0 \
+      --chaos crash@tick=2 \
+      > "$WORK/kill_responses.jsonl"
+
+python - "$WORK/ref_responses.jsonl" "$WORK/kill_responses.jsonl" \
+         "$WORK/kill_tele.jsonl" <<'PY'
+import json
+import sys
+
+
+def stream(path):
+    toks, dones = [], 0
+    for line in open(path):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # chaos chatter shares the child's stdout
+        if rec.get("id") != "k1":
+            continue
+        if rec.get("event") == "token" and rec.get("token") is not None:
+            toks.append(rec["token"])
+        elif rec.get("event") == "done":
+            dones += 1
+    return toks, dones
+
+
+ref, ref_dones = stream(sys.argv[1])
+got, dones = stream(sys.argv[2])
+assert ref_dones == 1 and len(ref) == 10, (ref_dones, ref)
+assert dones == 1, f"expected exactly one done across both lives, got {dones}"
+assert got == ref, f"continuation mismatch: {got} != {ref}"
+resumed = any(
+    rec.get("name") == "serve_prefill" and rec.get("resumed")
+    for rec in (json.loads(l) for l in open(sys.argv[3]) if l.strip()))
+assert resumed, "telemetry shows no resumed prefill — did the replay run?"
+print(f"[serve_smoke] OK: kill-and-resume — {len(got)} tokens exactly "
+      "once across 2 process lives, bit-identical to the uninterrupted "
+      "run, replay visible on the stream")
+PY

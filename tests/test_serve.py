@@ -16,6 +16,7 @@ from __future__ import annotations
 import io
 import json
 import time
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -1031,6 +1032,443 @@ class TestLoadGenerator:
         assert any("ZERO prefix hits" in note
                    for note in d["cache_pressure"]), d["cache_pressure"]
 
+# ------------------------------------------- crash safety (journal/PR 8)
+
+
+class TestCrashReplay:
+    """Journal + replay against a live engine. Every engine here uses
+    the suite's already-compiled shapes (slots 2/3, max_len 48, buckets
+    8/16) so nothing in this class adds a jit compile to tier-1."""
+
+    def _streams_by_id(self, *streams):
+        per: dict[str, list[int]] = {}
+        for evs in streams:
+            for ev in evs:
+                if ev.kind == "token" and ev.token is not None:
+                    per.setdefault(ev.request.id, []).append(ev.token)
+        return per
+
+    def test_crash_replay_bit_identical_and_exactly_once(
+            self, tmp_path, llama):
+        """The tentpole oracle, in-process: an engine abandoned
+        mid-decode (the host-side equivalent of a kill — nothing is
+        drained, closed, or flushed beyond the journal's own appends)
+        is replaced by a fresh engine over the same journal; every
+        request completes bit-identical to `generate`, and the UNION of
+        both engines' client streams contains each token exactly once."""
+        from hyperion_tpu.obs import timeline
+        from hyperion_tpu.obs.report import read_records
+        from hyperion_tpu.obs.trace import Tracer
+        from hyperion_tpu.serve.journal import RequestJournal
+
+        model, variables = llama
+        jp = tmp_path / "journal.jsonl"
+        eng1 = _engine(llama)
+        eng1.journal = RequestJournal(jp)
+        eng1.warmup([8, 16])
+        s1: list = []
+        prompts = _prompts([5, 9, 4], seed=13)
+        reqs = [Request(prompt_ids=p, max_new_tokens=5 + i, id=f"cr{i}",
+                        sink=s1.append)
+                for i, p in enumerate(prompts)]
+        for r in reqs:
+            ok, reason = eng1.submit(r)
+            assert ok, reason
+        for _ in range(3):
+            eng1.step()  # mid-decode; eng1 is now abandoned, unclosed
+
+        tracer = Tracer(tmp_path / "telemetry.jsonl", run="replay_run")
+        eng2 = Engine(model, variables,
+                      EngineConfig(slots=3, max_len=48, eos_id=None),
+                      tracer=tracer, journal=RequestJournal(jp))
+        stats0 = eng2.warmup([8, 16])
+        s2: list = []
+        info = eng2.replay_pending(s2.append)
+        assert info["resumed"] == 3 and info["poisoned"] == 0
+        _drain(eng2)
+        eng2.journal.close_clean()
+        tracer.close()
+
+        for i, r in enumerate(reqs):
+            ref = np.asarray(generate(
+                model, variables, jnp.asarray(prompts[i])[None],
+                5 + i))[0].tolist()
+            per = self._streams_by_id(s1, s2)
+            assert per[f"cr{i}"] == ref, (
+                f"cr{i}: stream {per[f'cr{i}']} != oracle {ref}")
+        # replay never recompiled (same shapes, shared jit caches)
+        assert eng2.compile_stats() == stats0
+        # a clean journal owes nothing to the next life
+        assert RequestJournal(jp).pending_count() == 0
+        # the replay is visible to `obs trace` as a resumed request
+        records = read_records(tmp_path / "telemetry.jsonl")
+        assert any(r.get("name") == "serve_prefill" and r.get("resumed")
+                   for r in records)
+        rts = timeline.requests_from_records(records, run="replay_run")
+        segs = {name for rt in rts for (name, _, _) in rt.segments}
+        assert "replay_prefill" in segs
+
+    def test_two_crashes_then_completion(self, tmp_path, llama):
+        """Kill-twice-replay: two abandoned engines, the third
+        completes — outputs bit-identical, streams duplicate-free."""
+        from hyperion_tpu.serve.journal import RequestJournal
+
+        model, variables = llama
+        jp = tmp_path / "journal.jsonl"
+        prompts = _prompts([6, 8], seed=17)
+        budgets = [7, 6]
+        streams: list[list] = []
+        reqs = None
+        for life in range(3):
+            eng = _engine(llama)
+            eng.journal = RequestJournal(jp)
+            eng.warmup([8, 16])
+            sink_list: list = []
+            streams.append(sink_list)
+            if life == 0:
+                reqs = [Request(prompt_ids=p, max_new_tokens=budgets[i],
+                                id=f"kt{i}", sink=sink_list.append)
+                        for i, p in enumerate(prompts)]
+                for r in reqs:
+                    eng.submit(r)
+            else:
+                eng.replay_pending(sink_list.append)
+            if life < 2:
+                for _ in range(2):
+                    eng.step()  # crash again mid-decode
+            else:
+                _drain(eng)
+                eng.journal.close_clean()
+        per = self._streams_by_id(*streams)
+        for i, p in enumerate(prompts):
+            ref = np.asarray(generate(
+                model, variables, jnp.asarray(p)[None],
+                budgets[i]))[0].tolist()
+            assert per[f"kt{i}"] == ref, (per[f"kt{i}"], ref)
+        assert RequestJournal(jp).pending_count() == 0
+
+    def test_poisoned_replay_quarantines_with_event(self, tmp_path, llama):
+        """A journal showing max_replays prior resumes for an
+        unfinished request quarantines it: `request_poisoned` on the
+        stream, a rejected wire event for the client, nothing
+        re-admitted — the crash loop ends at the request, not the
+        replica."""
+        import json as json_mod
+
+        from hyperion_tpu.obs.trace import Tracer
+        from hyperion_tpu.serve.journal import RequestJournal
+        from hyperion_tpu.serve.queue import REJECT_POISONED
+
+        model, variables = llama
+        jp = tmp_path / "journal.jsonl"
+        j = RequestJournal(jp)
+        j.admit(Request(prompt_ids=_prompts([6], seed=23)[0],
+                        max_new_tokens=4, id="evil"))
+        j.close()
+        with jp.open("a") as f:  # two prior lives already replayed it
+            f.write(json_mod.dumps({"k": "replay", "id": "evil", "n": 1})
+                    + "\n")
+            f.write(json_mod.dumps({"k": "replay", "id": "evil", "n": 2})
+                    + "\n")
+        tracer = Tracer(tmp_path / "telemetry.jsonl", run="poison_run")
+        eng = Engine(model, variables,
+                     EngineConfig(slots=3, max_len=48, eos_id=None),
+                     tracer=tracer, journal=RequestJournal(jp))
+        got: list = []
+        info = eng.replay_pending(got.append)
+        tracer.close()
+        assert info == {"resumed": 0, "finished": 0, "poisoned": 1,
+                        "clean": False}
+        assert len(eng.queue) == 0
+        (ev,) = got
+        assert ev.kind == "rejected" and ev.reason == REJECT_POISONED
+        assert eng.metrics.summary()["poisoned"] == 1
+        recs = [json_mod.loads(line) for line in
+                (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+        assert any(r.get("name") == "request_poisoned"
+                   and r.get("request") == "evil" for r in recs)
+        # and the quarantine is durable: the next recovery skips it too
+        resume, _, poisoned, _ = RequestJournal(jp).recover()
+        assert resume == [] and poisoned == []
+
+
+class TestDrain:
+    def test_drain_under_load_finishes_owed_work(self, tmp_path, llama):
+        """SIGTERM semantics (engine half): begin_drain closes the door
+        — new submits reject with reason 'draining' — while in-flight
+        AND already-queued requests run to completion; the journal
+        closes clean, so the next start replays nothing."""
+        from hyperion_tpu.serve.journal import RequestJournal
+        from hyperion_tpu.serve.queue import REJECT_DRAINING
+
+        jp = tmp_path / "journal.jsonl"
+        eng = _engine(llama, slots=2)
+        eng.journal = RequestJournal(jp)
+        eng.warmup([8])
+        reqs = [Request(prompt_ids=p, max_new_tokens=4, id=f"dr{i}")
+                for i, p in enumerate(_prompts([6] * 4, seed=29))]
+        for r in reqs:
+            ok, reason = eng.submit(r)
+            assert ok, reason
+        eng.step()  # two in slots, two queued
+        eng.begin_drain(timeout_s=30.0)
+        assert eng.draining
+        late = Request(prompt_ids=_prompts([6], seed=31)[0],
+                       max_new_tokens=4, id="late")
+        ok, reason = eng.submit(late)
+        assert not ok and reason == REJECT_DRAINING
+        summary = eng.run()  # drains: draining + idle breaks the loop
+        assert summary["completed"] == 4
+        assert all(r.status == "done" for r in reqs)
+        assert eng.idle
+        eng.journal.close_clean()
+        assert RequestJournal(jp).pending_count() == 0
+
+    def test_drain_timeout_leaves_work_journaled(self, tmp_path, llama):
+        """A drain whose grace window closes with work still in hand
+        stops anyway — and the unfinished requests stay on the journal
+        for the next life instead of being lost."""
+        from hyperion_tpu.serve.journal import RequestJournal
+
+        jp = tmp_path / "journal.jsonl"
+        eng = _engine(llama, slots=2)
+        eng.journal = RequestJournal(jp)
+        eng.warmup([8])
+        for i, p in enumerate(_prompts([6] * 3, seed=37)):
+            eng.submit(Request(prompt_ids=p, max_new_tokens=40,
+                               id=f"dt{i}"))
+        eng.step()
+        eng.begin_drain(timeout_s=0.0)  # already expired
+        eng.run()
+        assert not eng.idle  # work abandoned at the deadline...
+        eng.journal.close()
+        assert RequestJournal(jp).pending_count() == 3  # ...but owed
+
+
+class TestBrownout:
+    def test_shed_clamp_events_and_doctor_naming(self, tmp_path, llama):
+        """Overload brownout end to end on a live engine: depth
+        watermark trips the governor, deadline-doomed queued requests
+        shed with reason shed_deadline, new admissions get their budget
+        clamped (journal records the clamped value), hysteresis exits
+        once the queue empties, and `obs doctor` names the incident."""
+        from hyperion_tpu.obs import doctor
+        from hyperion_tpu.obs.trace import Tracer
+        from hyperion_tpu.serve.queue import REJECT_SHED
+
+        model, variables = llama
+        tracer = Tracer(tmp_path / "telemetry.jsonl", run="brownout_run")
+        eng = Engine(
+            model, variables,
+            EngineConfig(slots=2, max_len=48, eos_id=None,
+                         queue_capacity=16, brownout=True,
+                         brownout_depth=2, brownout_clamp=2),
+            tracer=tracer)
+        eng.warmup([8])
+        rng_prompts = _prompts([6] * 4, seed=41)
+        keepers = [Request(prompt_ids=p, max_new_tokens=3, id=f"bk{i}")
+                   for i, p in enumerate(rng_prompts)]
+        doomed = [Request(prompt_ids=p, max_new_tokens=3, id=f"bd{i}",
+                          deadline_s=0.004)
+                  for i, p in enumerate(_prompts([6] * 2, seed=43))]
+        shed_events: list = []
+        for r in keepers + doomed:
+            r.sink = (lambda ev: shed_events.append(ev)
+                      if ev.kind == "rejected" else None)
+            ok, reason = eng.submit(r)
+            assert ok, reason
+        time.sleep(0.01)  # the doomed deadlines pass
+        eng.step()  # depth 6 >= 2: enter + shed
+        assert eng._governor.active
+        s = eng.metrics.summary()
+        assert s["shed"] == 2
+        assert all(r.status == "rejected" for r in doomed)
+        assert all(r.finish_reason == REJECT_SHED for r in doomed)
+        # clamp while active: an 8-token ask is served at 2
+        clamped = Request(prompt_ids=_prompts([6], seed=47)[0],
+                          max_new_tokens=8, id="bclamp")
+        ok, _ = eng.submit(clamped)
+        assert ok
+        _drain(eng)
+        assert clamped.clamped_from == 8 and len(clamped.tokens) == 2
+        assert not eng._governor.active  # hysteresis exited at depth 0
+        summary = eng.run()  # idle: emits serve_end + final snapshot
+        tracer.close()
+        assert summary["brownout_clamped"] == 1
+        assert summary["brownout_active"] is False
+
+        d = doctor.diagnose(tmp_path)
+        assert d["verdict"] == "healthy", d["reason"]
+        assert d["overload"], "brownout produced no named incident"
+        assert any("shed 2" in o for o in d["overload"])
+        assert "serving robustness" in d["reason"]
+        recs = [json.loads(line) for line in
+                (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+        names = [r.get("name") for r in recs]
+        assert "brownout_enter" in names and "brownout_exit" in names
+        shed_recs = [r for r in recs if r.get("name") == "request_rejected"
+                     and r.get("reason") == REJECT_SHED]
+        assert len(shed_recs) == 2
+        assert all(r.get("shed") and r.get("queued_s") is not None
+                   for r in shed_recs)
+
+
+class TestFrontEndHardening:
+    def test_malformed_line_is_a_counted_bad_request(self, tmp_path, llama):
+        """Satellite: a malformed JSONL line produces a bad_request
+        reject on the metrics/stream — never an engine-thread
+        exception — while well-formed neighbours still complete."""
+        from hyperion_tpu.obs.trace import Tracer
+        from hyperion_tpu.serve.queue import REJECT_BAD_REQUEST
+        from hyperion_tpu.serve.server import serve_jsonl
+
+        model, variables = llama
+        tracer = Tracer(tmp_path / "telemetry.jsonl", run="badline_run")
+        eng = Engine(model, variables,
+                     EngineConfig(slots=2, max_len=48, eos_id=None),
+                     tracer=tracer)
+        eng.warmup([8])
+        lines = [
+            json.dumps({"id": "ok1", "prompt_ids": list(range(2, 8)),
+                        "max_new_tokens": 3}),
+            "{broken json",
+            json.dumps({"id": "bad_ids", "prompt_ids": "not-a-list",
+                        "max_new_tokens": 3}),
+            json.dumps({"id": "no_prompt"}),
+        ]
+        out = io.StringIO()
+        summary = serve_jsonl(eng, io.StringIO("\n".join(lines) + "\n"),
+                              out)
+        tracer.close()
+        recs = [json.loads(line) for line in out.getvalue().splitlines()]
+        assert {r["id"] for r in recs if r.get("event") == "done"} == {"ok1"}
+        assert sum(1 for r in recs if r.get("event") == "error") == 3
+        assert summary["completed"] == 1
+        snap = eng.metrics.reg.snapshot()["counters"]
+        assert snap[f"serve_rejected_{REJECT_BAD_REQUEST}"] == 3
+        stream = [json.loads(line) for line in
+                  (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+        bad = [r for r in stream if r.get("name") == "request_rejected"
+               and r.get("reason") == REJECT_BAD_REQUEST]
+        assert len(bad) == 3
+
+    def test_mid_stream_disconnect_drops_sink_with_event(
+            self, tmp_path, llama):
+        """Satellite: a client that dies mid-stream costs its own
+        request only — the sink is dropped, a client_disconnected
+        event lands, the counter moves, and the engine finishes the
+        slot out."""
+        from hyperion_tpu.obs.trace import Tracer
+
+        model, variables = llama
+        tracer = Tracer(tmp_path / "telemetry.jsonl", run="dead_client")
+        eng = Engine(model, variables,
+                     EngineConfig(slots=2, max_len=48, eos_id=None),
+                     tracer=tracer)
+        eng.warmup([8])
+        calls = {"n": 0}
+
+        def dying_sink(ev):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise BrokenPipeError("client gone")
+
+        req = Request(prompt_ids=_prompts([6], seed=53)[0],
+                      max_new_tokens=5, id="dead", sink=dying_sink)
+        healthy: list = []
+        other = Request(prompt_ids=_prompts([7], seed=54)[0],
+                        max_new_tokens=5, id="alive",
+                        sink=healthy.append)
+        eng.submit(req)
+        eng.submit(other)
+        _drain(eng)
+        tracer.close()
+        assert req.status == "done" and len(req.tokens) == 5
+        assert req.sink is None  # dropped at the second write
+        assert other.status == "done"
+        assert eng.metrics.summary()["dropped_sinks"] == 1
+        recs = [json.loads(line) for line in
+                (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+        assert any(r.get("name") == "client_disconnected"
+                   and r.get("request") == "dead" for r in recs)
+
+
+class TestSupervisedKill:
+    def test_sigkill_twice_under_supervise_bit_identical(
+            self, tmp_path, llama):
+        """The acceptance subprocess test: `hyperion serve --supervise`
+        with two hard crashes mid-decode (`crash@tick` = `os._exit`,
+        nothing flushed beyond the kernel). The supervisor restarts
+        twice, the journal replays across three process lives, and the
+        client's combined stdout stream carries every request's temp-0
+        tokens bit-identical to an uninterrupted `generate` — each
+        token exactly once, one done per request."""
+        import os
+        import subprocess
+        import sys as sys_mod
+
+        from hyperion_tpu.checkpoint.io import export_gathered
+        from hyperion_tpu.obs.report import read_records
+
+        model, variables = llama
+        ckpt = tmp_path / "llama.npz"
+        export_gathered(ckpt, variables["params"])
+        jp = tmp_path / "journal.jsonl"
+        tele = tmp_path / "telemetry.jsonl"
+        prompts = _prompts([6, 7], seed=61)
+        budgets = [12, 10]
+        lines = "".join(
+            json.dumps({"id": f"k{i}", "prompt_ids": p.tolist(),
+                        "max_new_tokens": budgets[i]}) + "\n"
+            for i, p in enumerate(prompts))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   HYPERION_TELEMETRY=str(tele))
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        r = subprocess.run(
+            [sys_mod.executable, "-m", "hyperion_tpu.cli.main", "serve",
+             "--ckpt", str(ckpt), "--no-tokenizer",
+             "--max-len", "48", "--slots", "2", "--warmup-lens", "8,32",
+             "--journal", str(jp),
+             "--supervise", "--max-restarts", "3", "--hang-timeout", "0",
+             "--chaos", "crash@tick=3,crash@tick=6"],
+            input=lines, env=env, capture_output=True, text=True,
+            timeout=420, cwd=str(Path(__file__).resolve().parents[1]),
+        )
+        assert r.returncode == 0, r.stderr[-3000:]
+        assert r.stderr.count("[serve-supervisor] child exit 70") == 2
+        assert r.stdout.count("[chaos] firing crash@tick") == 2
+
+        per_tokens: dict[str, list[int]] = {}
+        dones: dict[str, int] = {}
+        for line in r.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # chaos chatter on the shared stdout
+            if rec.get("event") == "token" and rec.get("token") is not None:
+                per_tokens.setdefault(rec["id"], []).append(rec["token"])
+            elif rec.get("event") == "done":
+                dones[rec["id"]] = dones.get(rec["id"], 0) + 1
+        for i, p in enumerate(prompts):
+            ref = np.asarray(generate(
+                model, variables, jnp.asarray(p)[None],
+                budgets[i]))[0].tolist()
+            assert per_tokens[f"k{i}"] == ref, (
+                f"k{i}: {per_tokens[f'k{i}']} != {ref}")
+            assert dones[f"k{i}"] == 1
+        # the journal drained clean in the last life
+        from hyperion_tpu.serve.journal import RequestJournal
+
+        assert RequestJournal(jp).pending_count() == 0
+        # the replays are visible on the stream as resumed requests
+        records = read_records(tele)
+        assert any(rec.get("name") == "serve_prefill" and rec.get("resumed")
+                   for rec in records)
+        assert any(rec.get("name") == "request_admitted"
+                   and rec.get("replayed") for rec in records)
+
+
+class TestLoadSoak:
     @pytest.mark.slow
     def test_soak_under_poisson_load(self, llama):
         """Longer closed-loop soak: backpressure engages (tiny queue),

@@ -1,0 +1,526 @@
+"""Crash-safe serving, host half: the request journal's WAL + replay
+semantics, the poison-pill rule, the brownout governor's hysteresis,
+the drain door, deadline-aware shedding, the new serve-scoped chaos
+clauses, and the serve supervisor loop — all jax-free and fast.
+
+The engine-integrated halves (bit-identical replay, drain under load,
+shed/clamp through a live engine, the supervised SIGKILL subprocess
+round trip) live in tests/test_serve.py, where the compiled tiny-llama
+shapes are shared with the rest of the suite.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from hyperion_tpu.serve.journal import RequestJournal
+from hyperion_tpu.serve.queue import (
+    REJECT_DRAINING,
+    AdmissionQueue,
+    BrownoutGovernor,
+    Request,
+)
+from hyperion_tpu.testing import chaos
+
+
+def _req(n=4, rid="", **kw):
+    kw.setdefault("max_new_tokens", 4)
+    return Request(prompt_ids=np.arange(1, n + 1, dtype=np.int32),
+                   id=rid, **kw)
+
+
+# ------------------------------------------------------------- journal
+
+
+class TestJournal:
+    def test_round_trip_resumes_unfinished_in_admit_order(self, tmp_path):
+        """Admitted-but-unfinished requests come back with their
+        journaled tokens riding along (the recompute-resume payload),
+        sampling params intact, in original admit order."""
+        jp = tmp_path / "j.jsonl"
+        j = RequestJournal(jp)
+        a = _req(5, "a", max_new_tokens=8, temperature=0.7, top_k=5,
+                 top_p=0.9, seed=42)
+        b = _req(3, "b", max_new_tokens=6)
+        j.admit(a)
+        j.admit(b)
+        j.token("a", 17)
+        j.token("a", 21)
+        j.close()
+
+        resume, finished, poisoned, clean = RequestJournal(jp).recover()
+        assert not clean and not finished and not poisoned
+        assert [r.id for r in resume] == ["a", "b"]
+        ra, rb = resume
+        assert ra.tokens == [17, 21] and rb.tokens == []
+        assert ra.prompt_ids.tolist() == a.prompt_ids.tolist()
+        assert (ra.max_new_tokens, ra.temperature, ra.top_k, ra.top_p,
+                ra.seed) == (8, 0.7, 5, 0.9, 42)
+        assert ra.replays == 1  # this recovery marked itself
+
+    def test_finished_requests_never_replayed(self, tmp_path):
+        jp = tmp_path / "j.jsonl"
+        j = RequestJournal(jp)
+        j.admit(_req(4, "a"))
+        j.token("a", 9)
+        j.finish("a", "done")
+        j.close()
+        resume, finished, poisoned, clean = RequestJournal(jp).recover()
+        assert resume == [] and finished == [] and poisoned == []
+
+    def test_clean_close_means_empty_replay_set(self, tmp_path):
+        """The drain contract: a cleanly closed journal owes nothing,
+        even if (pathologically) records precede the close marker."""
+        jp = tmp_path / "j.jsonl"
+        j = RequestJournal(jp)
+        j.admit(_req(4, "a"))
+        j.token("a", 9)
+        j.close_clean()
+        assert j.clean_closed
+        resume, finished, poisoned, clean = RequestJournal(jp).recover()
+        assert clean and resume == [] and poisoned == []
+        assert RequestJournal(jp).pending_count() == 0
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        """The record a SIGKILL'd process never finished writing must
+        not abort recovery — it IS the crash signature."""
+        jp = tmp_path / "j.jsonl"
+        j = RequestJournal(jp)
+        j.admit(_req(4, "a"))
+        j.token("a", 7)
+        j.close()
+        with jp.open("a") as f:
+            f.write('{"k":"tok","id":"a","to')  # torn mid-write
+        resume, _, _, _ = RequestJournal(jp).recover()
+        assert [r.id for r in resume] == ["a"]
+        assert resume[0].tokens == [7]
+
+    def test_complete_output_recovers_as_finished_not_resumed(
+            self, tmp_path):
+        """All budgeted tokens journaled but the terminal record lost:
+        nothing to compute — re-prefilling would sample an EXTRA token
+        past the budget. The request lands in `finished` (the client is
+        owed only its done line) and gets its terminal record now."""
+        jp = tmp_path / "j.jsonl"
+        j = RequestJournal(jp)
+        j.admit(_req(4, "a", max_new_tokens=3))
+        for t in (5, 6, 7):
+            j.token("a", t)
+        j.close()
+        resume, finished, _, _ = RequestJournal(jp).recover()
+        assert resume == [] and [r.id for r in finished] == ["a"]
+        assert finished[0].tokens == [5, 6, 7]
+        # the terminal record was backfilled: the next recovery owes nothing
+        assert RequestJournal(jp).pending_count() == 0
+
+    def test_eos_terminated_output_recovers_as_finished(self, tmp_path):
+        jp = tmp_path / "j.jsonl"
+        j = RequestJournal(jp)
+        j.admit(_req(4, "a", max_new_tokens=10))
+        j.token("a", 5)
+        j.token("a", 2)  # eos
+        j.close()
+        resume, finished, _, _ = RequestJournal(jp).recover(eos_id=2)
+        assert resume == [] and [r.id for r in finished] == ["a"]
+
+    def test_poison_rule_quarantines_after_max_replays(self, tmp_path):
+        """Three recoveries with the same unfinished request: replay,
+        replay, POISON — the adversarial request stops crash-looping
+        the replica, and later recoveries skip it permanently."""
+        jp = tmp_path / "j.jsonl"
+        j = RequestJournal(jp)
+        j.admit(_req(4, "evil"))
+        j.close()
+        r1, _, p1, _ = RequestJournal(jp).recover(max_replays=2)
+        assert [r.id for r in r1] == ["evil"] and p1 == []
+        r2, _, p2, _ = RequestJournal(jp).recover(max_replays=2)
+        assert [r.id for r in r2] == ["evil"] and p2 == []
+        r3, _, p3, _ = RequestJournal(jp).recover(max_replays=2)
+        assert r3 == [] and [r.id for r in p3] == ["evil"]
+        assert p3[0].replays == 2
+        # permanently: the fourth recovery does not resurrect it
+        r4, _, p4, _ = RequestJournal(jp).recover(max_replays=2)
+        assert r4 == [] and p4 == []
+
+    def test_io_failure_disables_never_raises(self, tmp_path):
+        fails = {"n": 0}
+
+        def fault(tag):
+            fails["n"] += 1
+            raise OSError("disk on fire")
+
+        j = RequestJournal(tmp_path / "j.jsonl", fault=fault)
+        j.admit(_req(4, "a"))  # must not raise
+        assert not j.enabled and "disk on fire" in (j.error or "")
+        j.token("a", 1)  # disabled: silent no-op, no second fault call
+        assert fails["n"] == 1
+
+    def test_journal_io_fail_chaos_clause(self, tmp_path):
+        plan = chaos.ChaosPlan(chaos.parse_plan("journal_io_fail@p=1.0"))
+        j = RequestJournal(tmp_path / "j.jsonl", fault=plan.journal_io)
+        j.admit(_req(4, "a"))
+        assert not j.enabled and "journal_io_fail" in (j.error or "")
+        # p=0 never fires
+        plan0 = chaos.ChaosPlan(chaos.parse_plan("journal_io_fail@p=0.0"))
+        j0 = RequestJournal(tmp_path / "j0.jsonl", fault=plan0.journal_io)
+        j0.admit(_req(4, "a"))
+        assert j0.enabled
+
+    def test_records_after_close_start_a_new_life(self, tmp_path):
+        """A journal reused after a clean close (same path, next serve
+        run) replays the NEW run's unfinished work — including when a
+        client REUSES a request id: the old life's done marker must not
+        skip the new life's replay, and the old life's tokens must not
+        leak into the resume payload."""
+        jp = tmp_path / "j.jsonl"
+        j = RequestJournal(jp)
+        j.admit(_req(4, "old"))
+        j.finish("old", "done")
+        j.admit(_req(4, "reused"))
+        j.token("reused", 99)  # old life's token: settled history
+        j.finish("reused", "done")
+        j.close_clean()
+        j2 = RequestJournal(jp)
+        j2.admit(_req(4, "new"))
+        j2.admit(_req(5, "reused"))  # same id, new life, unfinished
+        j2.token("reused", 7)
+        j2.close()
+        resume, _, _, clean = RequestJournal(jp).recover()
+        assert not clean
+        assert [r.id for r in resume] == ["new", "reused"]
+        (reused,) = [r for r in resume if r.id == "reused"]
+        assert reused.tokens == [7]  # not [99, 7]
+        assert reused.prompt_len == 5  # the NEW life's admit record
+
+
+# ---------------------------------------------------- brownout governor
+
+
+class TestBrownoutGovernor:
+    def test_depth_hysteresis_no_flap(self):
+        g = BrownoutGovernor(depth_high=8)  # low defaults to 4
+        assert g.update(7) is None and not g.active
+        assert g.update(8) == "enter" and g.active
+        # between the watermarks: stays active, no transition spam
+        for d in (7, 6, 5):
+            assert g.update(d) is None and g.active
+        assert g.update(4) == "exit" and not g.active
+        # between the watermarks from below: stays OFF — the half the
+        # hysteresis exists for
+        for d in (5, 6, 7):
+            assert g.update(d) is None and not g.active
+        assert g.update(9) == "enter"
+
+    def test_wait_watermark_enters_and_exits(self):
+        g = BrownoutGovernor(depth_high=0, wait_high_s=1.0)
+        for _ in range(10):
+            g.observe_wait(2.0)
+        assert g.update(0) == "enter"
+        # exit clears the stale window, so recovery is immediate once
+        # the observed waits are gone
+        assert g.update(0) is None  # p95 still 2.0 > low 0.5
+        g._waits.clear()
+        g.observe_wait(0.1)
+        assert g.update(0) == "exit"
+        assert g.update(0) is None
+
+    def test_both_signals_must_clear_to_exit(self):
+        g = BrownoutGovernor(depth_high=4, wait_high_s=1.0)
+        for _ in range(5):
+            g.observe_wait(2.0)
+        assert g.update(10) == "enter"
+        assert g.update(0) is None  # depth fine, wait p95 still high
+        g._waits.clear()
+        g.observe_wait(0.0)
+        assert g.update(10) is None  # wait fine, depth still high
+        assert g.update(0) == "exit"
+
+    def test_needs_a_watermark(self):
+        with pytest.raises(ValueError):
+            BrownoutGovernor(depth_high=0)
+
+
+# ----------------------------------------------------- drain + shedding
+
+
+class TestDrainDoor:
+    def test_closed_queue_rejects_with_draining(self):
+        q = AdmissionQueue(4, max_total_tokens=64)
+        assert q.submit(_req(4)) == (True, None)
+        q.close()
+        ok, reason = q.submit(_req(4))
+        assert not ok and reason == REJECT_DRAINING
+        assert q.closed
+        # already-accepted work still pops: drain finishes what it owes
+        admit, _ = q.pop_ready(2)
+        assert len(admit) == 1
+
+    def test_shed_doomed_is_deadline_aware(self):
+        q = AdmissionQueue(8, max_total_tokens=64)
+        doomed = _req(4, "doomed", deadline_s=0.05)
+        winner = _req(4, "winner", deadline_s=60.0)
+        no_slo = _req(4, "no_slo")  # no deadline: never shed
+        for r in (doomed, winner, no_slo):
+            q.submit(r)
+        now = time.monotonic()
+        # est wait 1 s: doomed (50 ms headroom) cannot win; winner can
+        shed = q.shed_doomed(now, est_wait_s=1.0)
+        assert [r.id for r in shed] == ["doomed"]
+        assert doomed.status == "rejected"
+        assert len(q) == 2
+
+    def test_shed_orders_most_doomed_first(self):
+        q = AdmissionQueue(8, max_total_tokens=64)
+        late = _req(4, "late", deadline_s=0.08)
+        soon = _req(4, "soon", deadline_s=0.01)
+        q.submit(late)
+        q.submit(soon)
+        shed = q.shed_doomed(time.monotonic(), est_wait_s=5.0)
+        assert [r.id for r in shed] == ["soon", "late"]
+
+
+# ------------------------------------------------------- chaos grammar
+
+
+class TestServeChaosGrammar:
+    def test_new_clauses_parse_with_keys(self):
+        faults = chaos.parse_plan(
+            "crash@tick=3,journal_io_fail@p=0.25,poison_request@id=req_7")
+        assert [f.key for f in faults] == [
+            "crash@tick=3", "journal_io_fail@p=0.25",
+            "poison_request@id=req_7"]
+        assert faults[0].unit == "tick"
+        assert faults[2].rid == "req_7"
+
+    def test_crash_is_tick_scoped_only(self):
+        with pytest.raises(ValueError, match="unknown chaos clause"):
+            chaos.parse_plan("crash@step=3")
+
+    def test_journal_p_validated(self):
+        with pytest.raises(ValueError, match="outside"):
+            chaos.parse_plan("journal_io_fail@p=1.5")
+
+    def test_poison_only_fires_on_matching_request(self):
+        """Unit isolation: poison_request must not fire from step/tick
+        hooks nor for other request ids (on a match it would SIGKILL —
+        reaching the assertion IS the test)."""
+        plan = chaos.ChaosPlan(chaos.parse_plan("poison_request@id=evil"))
+        plan.on_step(0)
+        plan.on_tick(0)
+        plan.on_request("innocent")
+        assert not plan._fired  # poison is exempt from the fire record
+
+    def test_journal_io_uses_its_own_rng_stream(self):
+        """Adding a journal clause must not shift the io_fail@p draw
+        sequence the checkpoint-retry tests pinned."""
+        a = chaos.ChaosPlan(chaos.parse_plan("io_fail@p=0.5"), seed=3)
+        b = chaos.ChaosPlan(
+            chaos.parse_plan("io_fail@p=0.5,journal_io_fail@p=0.5"),
+            seed=3)
+        seq_a, seq_b = [], []
+        for _ in range(32):
+            for plan, seq in ((a, seq_a), (b, seq_b)):
+                try:
+                    plan.io_fail("t")
+                    seq.append(0)
+                except OSError:
+                    seq.append(1)
+            try:
+                b.journal_io("t")  # interleave journal draws into b
+            except OSError:
+                pass
+        assert seq_a == seq_b
+
+
+# --------------------------------------------------- supervisor (serve)
+
+
+class TestServeSupervisor:
+    def test_loop_restarts_on_crash_and_gives_up(self):
+        from hyperion_tpu.supervisor import (
+            EXIT_GAVE_UP,
+            Decision,
+            supervise_loop,
+        )
+
+        rcs = [70, 70, 70, 70]
+        attempts = []
+
+        def child(argv, env):
+            attempts.append(env["HYPERION_ATTEMPT"])
+            return rcs.pop(0)
+
+        rc = supervise_loop(["serve"], decide=lambda rc: Decision.restart(),
+                            max_restarts=2, run_child=child,
+                            sleep=lambda s: None, label="serve-supervisor")
+        assert rc == EXIT_GAVE_UP
+        assert attempts == ["0", "1", "2"]
+
+    def test_loop_stops_on_success_and_usage(self):
+        from hyperion_tpu.supervisor import Decision, supervise_loop
+
+        assert supervise_loop(
+            ["x"], decide=lambda rc: Decision.restart(), max_restarts=5,
+            run_child=lambda a, e: 0, sleep=lambda s: None) == 0
+        assert supervise_loop(
+            ["x"], decide=lambda rc: Decision.restart(), max_restarts=5,
+            run_child=lambda a, e: 2, sleep=lambda s: None) == 2
+
+    def test_heartbeat_watchdog_kills_stale_child(self, tmp_path):
+        """A child that never beats (wedged before its first beat) is
+        SIGKILLed once the stale window passes and reported as hung."""
+        from hyperion_tpu.supervisor import RC_HUNG, heartbeat_watchdog
+
+        runner = heartbeat_watchdog(tmp_path / "heartbeat.json",
+                                    stale_s=0.5, poll_s=0.05)
+        t0 = time.monotonic()
+        rc = runner([sys.executable, "-c", "import time; time.sleep(60)"],
+                    None)
+        assert rc == RC_HUNG
+        assert time.monotonic() - t0 < 30
+
+    def test_heartbeat_watchdog_fresh_child_exits_normally(self, tmp_path):
+        from hyperion_tpu.supervisor import heartbeat_watchdog
+
+        hb = tmp_path / "heartbeat.json"
+        hb.write_text("{}")
+        runner = heartbeat_watchdog(hb, stale_s=30.0, poll_s=0.05)
+        assert runner([sys.executable, "-c", "raise SystemExit(7)"],
+                      None) == 7
+
+    def test_serve_strip_supervise_flags(self):
+        from hyperion_tpu.serve.server import _strip_supervise_flags
+
+        argv = ["--ckpt", "m.npz", "--supervise", "--max-restarts", "3",
+                "--hang-timeout", "5", "--journal", "j.jsonl"]
+        assert _strip_supervise_flags(argv) == [
+            "--ckpt", "m.npz", "--journal", "j.jsonl"]
+        assert _strip_supervise_flags(
+            ["--max-restarts=3", "--hang-timeout=5", "--supervise"]) == []
+
+
+# ------------------------------------------- socket-path crash handling
+
+
+class TestStaleSocket:
+    def test_stale_socket_unlinked_live_socket_refused(self, tmp_path):
+        import socket as socket_mod
+
+        from hyperion_tpu.serve.server import prepare_socket_path
+
+        # nonexistent: no-op
+        prepare_socket_path(str(tmp_path / "none.sock"))
+
+        # stale file a crashed server left behind: unlinked
+        stale = tmp_path / "stale.sock"
+        s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+        s.bind(str(stale))
+        s.close()  # bound then closed without listen: connect refuses
+        assert stale.exists()
+        prepare_socket_path(str(stale))
+        assert not stale.exists()
+
+        # live listener: refused loudly, file untouched
+        live = tmp_path / "live.sock"
+        srv = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+        srv.bind(str(live))
+        srv.listen(1)
+        try:
+            with pytest.raises(RuntimeError, match="live server"):
+                prepare_socket_path(str(live))
+            assert live.exists()
+        finally:
+            srv.close()
+
+
+# ------------------------------------------------ doctor + diff (files)
+
+
+class TestObsIntegration:
+    def _stream(self, tmp_path, counters, gauges=None, events=()):
+        run = "serve_rb"
+        recs = [
+            {"v": 1, "kind": "event", "name": "serve_start", "run": run,
+             "proc": 0, "t_wall": 100.0, "t_mono": 1.0},
+            {"v": 1, "kind": "span", "name": "serve_tick", "run": run,
+             "proc": 0, "step": 1, "t_wall": 100.5, "t_mono": 1.5,
+             "dur_ms": 2.0},
+        ]
+        for name, attrs in events:
+            recs.append({"v": 1, "kind": "event", "name": name,
+                         "run": run, "proc": 0, "t_wall": 101.0,
+                         "t_mono": 2.0, **attrs})
+        recs.append({
+            "v": 1, "kind": "snapshot", "name": "metrics", "run": run,
+            "proc": 0, "t_wall": 102.0, "t_mono": 3.0,
+            "metrics": {"counters": {"serve_ticks": 5, **counters},
+                        "gauges": {"queue_depth": 0.0, **(gauges or {})},
+                        "histograms": {}},
+        })
+        recs.append({"v": 1, "kind": "event", "name": "serve_end",
+                     "run": run, "proc": 0, "t_wall": 103.0,
+                     "t_mono": 4.0, "completed": 3})
+        p = tmp_path / "telemetry.jsonl"
+        p.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        return tmp_path
+
+    def test_doctor_names_brownout_overload(self, tmp_path):
+        from hyperion_tpu.obs import doctor
+
+        d = doctor.diagnose(self._stream(
+            tmp_path, {"serve_shed": 4, "serve_brownout_clamped": 2},
+            gauges={"serve_brownout_active": 1.0}))
+        assert d["verdict"] == "healthy"
+        assert d["overload"], "brownout left no named incident"
+        assert any("shed 4" in o for o in d["overload"])
+        assert any("clamped" in o for o in d["overload"])
+        assert any("ACTIVE" in o for o in d["overload"])
+        assert "serving robustness" in d["reason"]
+        md = doctor.render_markdown(d)
+        assert "serve robustness" in md and "overload" in md
+
+    def test_doctor_names_poisoned_request_and_journal_error(
+            self, tmp_path):
+        from hyperion_tpu.obs import doctor
+
+        d = doctor.diagnose(self._stream(
+            tmp_path,
+            {"serve_poisoned": 1, "serve_journal_errors": 1,
+             "serve_replayed": 2},
+            events=[("request_poisoned",
+                     {"request": "evil_1", "replays": 2})]))
+        assert d["poisoned_requests"] == ["evil_1"]
+        assert any("poison pill" in o and "evil_1" in o
+                   for o in d["overload"])
+        assert any("journal" in o for o in d["overload"])
+
+    def test_diff_gates_shed_and_clamp_rates(self, tmp_path):
+        from hyperion_tpu.obs import diff as obs_diff
+
+        def line(shed, clamp):
+            return {"metric": "matmul_bf16_8192_tflops", "value": 100.0,
+                    "serving": {"tokens_per_s": 500.0,
+                                "shed_rate": shed, "clamp_rate": clamp}}
+
+        a, b = tmp_path / "a.json", tmp_path / "b.json"
+        a.write_text(json.dumps(line(0.01, 0.01)))
+        b.write_text(json.dumps(line(0.4, 0.5)))
+        d = obs_diff.diff(obs_diff.load_summary(a),
+                          obs_diff.load_summary(b))
+        assert {"serve_shed_rate", "serve_clamp_rate"} \
+            <= set(d["regressions"])
+
+    def test_smoke_script_has_kill_and_resume_round_trip(self):
+        """The CI satellite: serve_smoke.sh must carry the supervised
+        kill-and-resume leg (its flags are drift-guarded by
+        test_serve.py's parser check like every other invocation)."""
+        script = (Path(__file__).resolve().parents[1] / "scripts"
+                  / "serve_smoke.sh").read_text()
+        assert "--supervise" in script and "crash@tick" in script
+        assert "--journal" in script
