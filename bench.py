@@ -27,11 +27,13 @@ cannot hang the driver; failures still print ONE parseable JSON line.
 
 Secondary rows riding the same line: `extra` (GPT-2 LM train-step
 throughput), `input_pipeline` (host batch-assembly rate, sync vs
-background-prefetched), and `serving` (the continuous-batching engine
+background-prefetched), `serving` (the continuous-batching engine
 under a seeded Poisson load — tokens/sec, TTFT p50/p99, reject rate;
-serve/loadgen.py). The latter two are chip-free, so they are attached
-to failure lines too and `obs diff --history` tracks them across
-BENCH_r*.json.
+serve/loadgen.py), and `serving_scale` (`hyperion route` at 1 vs 2
+replicas over the real socket wire — aggregate tokens/sec, scaleup,
+per-replica fairness, affinity hit rate; serve/router.py). The
+chip-free rows are attached to failure lines too and
+`obs diff --history` tracks them across BENCH_r*.json.
 
 Telemetry: the probe/retry/deadline lifecycle additionally streams as
 `obs` events (probe_attempt, probe_result, measure_attempt,
@@ -320,6 +322,108 @@ def _child_serving() -> None:
     print(json.dumps(report))
 
 
+def _child_serving_scale() -> None:
+    """Replica-scaling probe: the SAME seeded socket workload driven
+    through `hyperion route` at 1 replica and again at N=2, on the
+    host backend over the real wire path (router socket -> dispatch ->
+    replica sockets). Reports aggregate serve_tokens_per_s at each
+    width, the scaleup ratio, per-replica request share (fairness =
+    min share x N; 1.0 = perfectly even), and the affinity hit rate —
+    the router-layer numbers `obs diff` gates so a dispatch-policy
+    regression can't hide behind healthy single-engine rows. Chip-free
+    like the serving probe; subprocess replicas compile the tiny model
+    each, so this is the slowest probe and runs last."""
+    import tempfile
+    import time as time_mod
+    from pathlib import Path
+
+    import jax
+
+    from hyperion_tpu.checkpoint.io import export_gathered
+    from hyperion_tpu.models.llama import Llama, llama_tiny_config
+    from hyperion_tpu.serve.loadgen import LoadSpec, run_load_socket
+
+    work = Path(tempfile.mkdtemp(prefix="serving_scale_"))
+    cfg = llama_tiny_config(max_len=128)
+    export_gathered(work / "llama.npz",
+                    Llama(cfg).init_params(jax.random.key(0), seq=8))
+    shared = 48
+    spec = LoadSpec(n_requests=16, rate_hz=40.0, prompt_lens=(4, 8, 16),
+                    max_new=(4, 8), vocab=cfg.vocab_size, seed=0,
+                    shared_prefix_tokens=shared)
+
+    def fleet(n: int) -> tuple[dict, dict]:
+        base = work / f"fleet_{n}"
+        sock = str(work / f"route_{n}.sock")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="")
+        env.pop("HYPERION_TELEMETRY", None)  # router stream defaults
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)  # on, under `base`
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "hyperion_tpu.cli.main", "route",
+             "--replicas", str(n), "--min-ready", str(n),
+             "--ckpt", str(work / "llama.npz"),
+             "--no-tokenizer", "--base-dir", str(base),
+             "--socket", sock, "--max-len", "128", "--slots", "2",
+             "--warmup-lens", f"8,{shared + 16}",
+             "--queue-capacity", "16",
+             "--replica-heartbeat-every", "1"],
+            env=env, stderr=subprocess.DEVNULL)
+        try:
+            t0 = time_mod.monotonic()
+            while not Path(sock).exists():
+                if proc.poll() is not None or \
+                        time_mod.monotonic() - t0 > 240:
+                    raise RuntimeError(f"router ({n} replicas) never "
+                                       "came up")
+                time_mod.sleep(0.2)
+            rep = run_load_socket(sock, spec, session_every=4)
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        end = {}
+        tele = base / "telemetry.jsonl"
+        if tele.exists():
+            for line in tele.read_text().splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("name") == "router_end":
+                    end = rec
+        return rep, end
+
+    rep1, _ = fleet(1)
+    n = 2
+    repn, endn = fleet(n)
+    share = endn.get("per_replica_dispatched") or {}
+    total = sum(share.values()) or 1
+    shares = {k: round(v / total, 4) for k, v in sorted(share.items())}
+    fairness = round(min(shares.values()) * len(shares), 4) \
+        if len(shares) == n else 0.0
+    tps1 = rep1.get("tokens_per_s") or 0.0
+    tpsn = repn.get("tokens_per_s") or 0.0
+    print(json.dumps({
+        "replicas": n,
+        "requests": spec.n_requests,
+        "completed_1r": rep1.get("completed"),
+        "completed": repn.get("completed"),
+        "tokens_per_s_1r": tps1,
+        "tokens_per_s": tpsn,
+        "scaleup": round(tpsn / tps1, 3) if tps1 else None,
+        "ttft_p50_ms": repn.get("ttft_p50_ms"),
+        "ttft_p99_ms": repn.get("ttft_p99_ms"),
+        "request_share": shares,
+        "fairness": fairness,
+        "affinity_hit_rate": endn.get("affinity_hit_rate"),
+        "redispatched": endn.get("redispatched"),
+        "ejections": endn.get("ejections"),
+    }))
+
+
 def _child_cpu_sanity() -> None:
     """The SAME measurement harness on the host CPU backend at small N.
     When the live value is 0.0 this row proves the harness itself works
@@ -469,6 +573,32 @@ def _add_serving(out: dict, hb, tracer, remaining) -> None:
                  # phases, not just growing
                  dominant_phase_p99=(srv or {}).get("dominant_phase_p99"),
                  ttft_p99_ms=(srv or {}).get("ttft_p99_ms"))
+
+
+def _add_serving_scale(out: dict, hb, tracer, remaining) -> None:
+    """Attach the replica-scaling probe row (`hyperion route` at 1 vs
+    2 replicas over the real socket wire path, `--child-serving-scale`).
+    Chip-free like the serving probe — the fleet rows ride success AND
+    failure lines — but the most expensive probe (subprocess replicas
+    each compile the tiny model), so it runs last and needs the most
+    budget left."""
+    if remaining() < 150:
+        out["serving_scale"] = {"error": "deadline reached; skipped"}
+        tracer.event("deadline", where="serving_scale",
+                     remaining_s=round(remaining(), 1))
+        return
+    hb.pulse(phase="serving_scale")
+    scl, serr = _run_child(
+        "--child-serving-scale", int(min(420, remaining() - 30)),
+        env={"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+    )
+    out["serving_scale"] = scl if scl is not None else {"error": serr}
+    tracer.event("serving_scale", ok=scl is not None,
+                 error=serr or None,
+                 tokens_per_s=(scl or {}).get("tokens_per_s"),
+                 scaleup=(scl or {}).get("scaleup"),
+                 fairness=(scl or {}).get("fairness"),
+                 affinity_hit_rate=(scl or {}).get("affinity_hit_rate"))
 
 
 def main() -> None:
@@ -645,6 +775,7 @@ def main() -> None:
             )
         _add_input_pipeline(out, hb, tracer, remaining)
         _add_serving(out, hb, tracer, remaining)
+        _add_serving_scale(out, hb, tracer, remaining)
         tracer.event("publish", value=0.0, failed=True, error=err)
         hb.close(phase="done", value=0.0)
         tracer.close()
@@ -700,6 +831,7 @@ def main() -> None:
         out["extra"] = {"error": "deadline reached; skipped"}
     _add_input_pipeline(out, hb, tracer, remaining)
     _add_serving(out, hb, tracer, remaining)
+    _add_serving_scale(out, hb, tracer, remaining)
     tracer.event("publish", value=out["value"], plausible=plausible,
                  vs_baseline=out["vs_baseline"])
     hb.close(phase="done", value=out["value"])
@@ -718,6 +850,8 @@ if __name__ == "__main__":
         _child_input_pipeline()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child-serving":
         _child_serving()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--child-serving-scale":
+        _child_serving_scale()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child-cpu-sanity":
         _child_cpu_sanity()
     else:

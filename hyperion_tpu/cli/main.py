@@ -321,6 +321,13 @@ def main(argv=None) -> int:
         from hyperion_tpu.serve.server import main as serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "route":
+        # replica-tier router (`hyperion route --replicas N --ckpt ...`
+        # — serve/router.py owns its arg surface; the router process
+        # never touches a jax backend, only its replica children do)
+        from hyperion_tpu.serve.router import main as route_main
+
+        return route_main(argv[1:])
     p = build_parser()
     args = p.parse_args(argv)
     if args.dry_init and args.model == "scaling":

@@ -73,6 +73,16 @@ METRICS: dict[str, str] = {
     # load means lost capacity — gated like any other serving regression
     "serve_shed_rate": "lower",
     "serve_clamp_rate": "lower",
+    # replica-tier scaling (serve/router.py via the bench serving_scale
+    # row): aggregate throughput at N replicas, scaleup vs one replica,
+    # dispatch fairness (min replica share x N; 1.0 = perfectly even),
+    # and the prefix/session affinity hit rate that keeps each
+    # replica's radix cache warm — any of them falling means the
+    # router, not an engine, regressed
+    "serve_scale_tokens_per_s": "higher",
+    "serve_scale_scaleup": "higher",
+    "serve_scale_fairness": "higher",
+    "serve_affinity_hit_rate": "higher",
 }
 
 
@@ -146,6 +156,16 @@ def normalize(doc: dict) -> dict[str, float]:
                               ("shed_rate", "serve_shed_rate"),
                               ("clamp_rate", "serve_clamp_rate")):
                 v = _num(srv.get(src))
+                if v is not None:
+                    out[name] = v
+        scale = doc.get("serving_scale")
+        if isinstance(scale, dict):
+            for src, name in (("tokens_per_s", "serve_scale_tokens_per_s"),
+                              ("scaleup", "serve_scale_scaleup"),
+                              ("fairness", "serve_scale_fairness"),
+                              ("affinity_hit_rate",
+                               "serve_affinity_hit_rate")):
+                v = _num(scale.get(src))
                 if v is not None:
                     out[name] = v
     # trainer *_summary.json {"step_ms": ..., "peak_hbm_mb": ...}

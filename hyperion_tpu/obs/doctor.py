@@ -45,7 +45,8 @@ from pathlib import Path
 from hyperion_tpu.obs.heartbeat import heartbeat_age_s, read_heartbeat
 from hyperion_tpu.obs.registry import percentile
 
-_TERMINAL_EVENTS = ("train_end", "generate_done", "publish", "serve_end")
+_TERMINAL_EVENTS = ("train_end", "generate_done", "publish", "serve_end",
+                    "router_end")
 _STEP_SPANS = ("train_step", "decode_step", "serve_tick")
 _FATAL_KINDS = ("nonfinite_loss", "nonfinite_grad")
 
@@ -99,6 +100,76 @@ def read_stream(path: str | Path) -> tuple[list[dict], int, bool]:
             bad += 1
             truncated_tail = i == len(lines) - 1
     return records, bad, truncated_tail
+
+
+def fleet_evidence(tele_path: Path, events: list[dict],
+                   now: float, stale_s: float = STALE_S,
+                   ) -> tuple[list[dict], list[str]]:
+    """Per-replica evidence for a router run (`hyperion route`): the
+    fleet layout puts each replica's artifacts in `replica_<i>/` next
+    to the router's stream, so one doctor invocation on the base dir
+    can render every replica's state and occupancy — and NAME a dead
+    replica instead of letting a silent child hide behind a healthy
+    router verdict. Returns (rows, incidents)."""
+    base = Path(tele_path).parent
+    ejected: dict[str, int] = {}
+    readmitted: dict[str, int] = {}
+    for e in events:
+        rid = e.get("replica")
+        if rid is None:
+            continue
+        if e.get("name") == "replica_ejected":
+            ejected[str(rid)] = ejected.get(str(rid), 0) + 1
+        elif e.get("name") in ("replica_ready", "replica_readmitted"):
+            readmitted[str(rid)] = readmitted.get(str(rid), 0) + 1
+    rows: list[dict] = []
+    incidents: list[str] = []
+    # numeric order: a 10+ replica fleet must not table as 0,1,10,11,2
+    for d in sorted(base.glob("replica_*"),
+                    key=lambda p: (not p.name.removeprefix(
+                        "replica_").isdigit(),
+                        int(p.name.removeprefix("replica_"))
+                        if p.name.removeprefix("replica_").isdigit()
+                        else 0, p.name)):
+        if not d.is_dir():
+            continue
+        idx = d.name.removeprefix("replica_")
+        hb = read_heartbeat(d / "heartbeat.json")
+        age = heartbeat_age_s(hb, now) if hb else None
+        phase = hb.get("phase") if hb else None
+        if hb is None:
+            state = "no heartbeat"
+        elif phase == "done":
+            state = "done"
+        elif age is not None and age > stale_s:
+            state = "dead"
+        else:
+            state = "beating"
+        rows.append({
+            "replica": idx, "state": state, "phase": phase,
+            "step": hb.get("step") if hb else None,
+            "pid": hb.get("pid") if hb else None,
+            "attempt": hb.get("attempt") if hb else None,
+            "active": hb.get("active") if hb else None,
+            "queue": hb.get("queue") if hb else None,
+            "age_s": round(age, 1) if age is not None else None,
+            "ejections": ejected.get(idx, 0),
+        })
+        if state == "dead":
+            occ = ""
+            if hb.get("active") is not None:
+                occ = (f" with {hb.get('active')} active + "
+                       f"{hb.get('queue')} queued in hand")
+            incidents.append(
+                f"replica {idx} DEAD — heartbeat stale "
+                f"({_age(age)} old, phase {phase!r}{occ}); its journal "
+                f"owes replay: check {d.name}/telemetry.jsonl for "
+                "journal_replayed on the next start")
+        elif state == "no heartbeat":
+            incidents.append(
+                f"replica {idx} never beat — child failed before its "
+                f"first heartbeat; read {d.name}/telemetry.jsonl")
+    return rows, incidents
 
 
 def diagnose(
@@ -380,6 +451,16 @@ def diagnose(
                                 "failed", "crashed", "hung"):
         reason += "; serving robustness: " + "; ".join(overload)
 
+    # Replica-fleet evidence (serve/router.py layout): a router run's
+    # own stream can be perfectly healthy while one of its children is
+    # dead — the fleet table makes each replica's state/occupancy a
+    # first-class evidence row, and a dead replica is a NAMED incident,
+    # not a throughput mystery.
+    fleet_rows, fleet_incidents = fleet_evidence(
+        tele_path, events, now, stale_s=stale_s)
+    if fleet_incidents:
+        reason += "; fleet: " + "; ".join(fleet_incidents)
+
     # Tail-attribution incidents (obs/timeline.py): the request-scoped
     # trace says WHERE the p99 went, so the doctor can name the FIX —
     # "raise --slots" and "raise --num-blocks" are different knobs a
@@ -472,6 +553,8 @@ def diagnose(
         ],
         "hbm_peak_mb": hbm_peak,
         "serve": serve,
+        "fleet": fleet_rows,
+        "fleet_incidents": fleet_incidents,
         "cache_pressure": cache_pressure,
         "overload": overload,
         "poisoned_requests": poisoned_ids,
@@ -593,6 +676,22 @@ def render_markdown(d: dict) -> str:
                 f"{_fmt(srv.get('prefix_hit_rate'))}, preempted "
                 f"{_fmt(srv.get('preempted'))}, HBM/req "
                 f"{_fmt(srv.get('hbm_per_req_mb'))} MB{flag} |")
+    for row in d.get("fleet") or []:
+        flag = (" — **dead**" if row["state"] == "dead"
+                else " — **never beat**" if row["state"] == "no heartbeat"
+                else "")
+        occ = ""
+        if row.get("active") is not None or row.get("queue") is not None:
+            occ = (f", active {_fmt(row.get('active'))}, "
+                   f"queue {_fmt(row.get('queue'))}")
+        ej = (f", {row['ejections']} ejection(s)"
+              if row.get("ejections") else "")
+        lines.append(
+            f"| replica {row['replica']} | {row['state']} "
+            f"(phase {row['phase']!r}, step {_fmt(row.get('step'))}, "
+            f"pid {_fmt(row.get('pid'))}, attempt "
+            f"{_fmt(row.get('attempt'))}{occ}, beat age "
+            f"{_fmt(row.get('age_s'))} s{ej}){flag} |")
     for row in d.get("tail_attribution") or []:
         comps = ", ".join(f"{p} {v:.1f}"
                           for p, v in row["components_ms"].items() if v)

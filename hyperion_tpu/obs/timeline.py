@@ -42,6 +42,7 @@ import argparse
 import dataclasses
 import json
 import math
+import re
 import sys
 from pathlib import Path
 
@@ -71,6 +72,7 @@ class RequestTrace:
 
     id: str
     status: str = "incomplete"   # done|rejected|timed_out|incomplete
+    replica: int | None = None   # replica index for router-fleet runs
     prompt_len: int | None = None
     n_tokens: int | None = None
     reason: str | None = None
@@ -118,12 +120,24 @@ def default_run(records: list[dict]) -> str | None:
     return list(runs_seen)[-1] if runs_seen else None
 
 
+def replica_of_run(run: str | None) -> int | None:
+    """Replica index a run id carries (`serve_r<i>_<ts>` — the tag
+    `serve/server.py` stamps when spawned by the router), else None."""
+    if not run:
+        return None
+    m = re.match(r"^serve_r(\d+)_", run)
+    return int(m.group(1)) if m else None
+
+
 def requests_from_records(records: list[dict],
                           run: str | None = None) -> list[RequestTrace]:
     """Rebuild per-request timelines from one run of a telemetry
-    stream (default: `default_run`)."""
+    stream (default: `default_run`). Runs produced by a router replica
+    carry the replica index in their run id; it is tagged onto every
+    RequestTrace so fleet-merged views keep attribution per replica."""
     if run is None:
         run = default_run(records)
+    replica = replica_of_run(run)
     recs = sorted(
         (r for r in records
          if r.get("run") == run and r.get("request")
@@ -135,7 +149,7 @@ def requests_from_records(records: list[dict],
     decode_start: dict[str, float] = {}    # id -> decode-segment start
     for r in recs:
         rid = str(r["request"])
-        rt = out.setdefault(rid, RequestTrace(id=rid))
+        rt = out.setdefault(rid, RequestTrace(id=rid, replica=replica))
         t = float(r["t_mono"])
         name = r.get("name")
         if r.get("kind") == "span" and name == "serve_prefill":
@@ -266,13 +280,15 @@ def chrome_trace(reqs: list[RequestTrace],
         })
     for i, r in enumerate(sorted(reqs, key=lambda x: x.t_submit or 0.0)):
         tid = i + 1
+        tag = f" r{r.replica}" if r.replica is not None else ""
         ev.append({"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
-                   "args": {"name": f"req {r.id} [{r.status}]"}})
+                   "args": {"name": f"req {r.id} [{r.status}]{tag}"}})
         for name, t, dur in r.segments:
             ev.append({
                 "name": name, "ph": "X", "pid": 1, "tid": tid,
                 "ts": us(t), "dur": round(dur * 1e6, 1),
-                "args": {"request": r.id},
+                "args": ({"request": r.id, "replica": r.replica}
+                         if r.replica is not None else {"request": r.id}),
             })
         for name, t in r.marks:
             ev.append({"name": name, "ph": "i", "s": "t", "pid": 1,

@@ -47,7 +47,10 @@ def _interpret() -> bool:
 def _compiler_params():
     if _interpret():
         return None
-    return pltpu.CompilerParams(
+    from hyperion_tpu.utils.compat import pallas_tpu_compiler_params
+
+    # via compat: jax 0.5 renamed TPUCompilerParams -> CompilerParams
+    return pallas_tpu_compiler_params(
         dimension_semantics=("parallel", "arbitrary"),
     )
 

@@ -27,6 +27,17 @@ deadline-doomed work with hysteresis instead of collapsing.
 `SERVING.md` documents the paged design, why recompile-free refill is
 the whole game on TPU, the tracing event vocabulary, and the crash
 recovery / drain / brownout semantics.
+
+One process is one replica. The replica tier (`router`, `replica`)
+multiplies it: `hyperion route --replicas N` spawns N engines as
+supervised children (own socket/journal/telemetry/heartbeat each) and
+dispatches with least-loaded scoring off the heartbeat payloads,
+session/prefix affinity so each replica's radix cache keeps hitting,
+heartbeat-gated ejection/readmission, and exactly-once failover —
+token stream indices + seed-deterministic recompute let a request
+whose replica died mid-stream finish on another replica without
+duplicating a single token, while the dead replica's journal replays
+sink-less on restart. `obs doctor <base-dir>` renders the fleet.
 """
 
 from hyperion_tpu.serve.blocks import (  # noqa: F401
@@ -42,3 +53,5 @@ from hyperion_tpu.serve.queue import (  # noqa: F401
     BrownoutGovernor,
     Request,
 )
+from hyperion_tpu.serve.replica import ReplicaHandle  # noqa: F401
+from hyperion_tpu.serve.router import Router, RouterPolicy  # noqa: F401

@@ -1,10 +1,17 @@
-"""Tiny JSONL client for the serve socket — tests and load drivers.
+"""Tiny JSONL client for the serve socket — tests, load drivers, and
+the replica router's dispatch path.
 
 Speaks exactly the `serve/server.py` wire protocol over a local unix
 socket: one JSON object per line out (the request), a stream of JSON
 objects per line back (token events, then a terminal `done` /
-`rejected` / `timed_out` / `error`). No retries, no pooling, no
-discovery — the serving client a test wants, not a production SDK.
+`rejected` / `timed_out` / `error`). No pooling, no discovery — but
+`connect()` retries with backoff on the two errors a *supervised
+restart* produces (connection refused while the new process warms up,
+socket file briefly absent between unlink and rebind), because a
+client that dies the instant its replica is restarted defeats the
+whole crash-safety story. Retry classification rides
+`utils/retry.py`; anything else (permission, a path that is not a
+socket) still fails immediately.
 """
 
 from __future__ import annotations
@@ -13,7 +20,26 @@ import json
 import socket
 from typing import Iterator
 
+from hyperion_tpu.utils.retry import RetryPolicy, retry_call
+
 TERMINAL_EVENTS = ("done", "rejected", "timed_out", "error")
+
+#: default connect policy: rides out a supervised replica restart
+#: (seconds of warmup) but gives up fast enough that "no server at all"
+#: is still a prompt, classified failure
+CONNECT_RETRY = RetryPolicy(tries=8, base_delay_s=0.05, max_delay_s=1.0,
+                            deadline_s=10.0)
+
+# a restarting server produces exactly these: REFUSED while nothing
+# listens on the (still-present or re-bound) socket file, ENOENT in the
+# window between the old file's unlink and the new bind, RESET when the
+# old process died with the connection half-open
+_TRANSIENT_CONNECT = (ConnectionRefusedError, ConnectionResetError,
+                      FileNotFoundError)
+
+
+def _connect_transient(exc: BaseException) -> bool:
+    return isinstance(exc, _TRANSIENT_CONNECT)
 
 
 class ServeClient:
@@ -22,18 +48,36 @@ class ServeClient:
     with ServeClient("/tmp/hyperion.sock") as c:
         for ev in c.stream(prompt_ids=[5, 9, 12], max_new_tokens=8):
             ...
+
+    `retry` is the connect backoff policy (None disables: first
+    refusal is final — the pre-restart-era behavior, still right for
+    probes that must not wait).
     """
 
-    def __init__(self, socket_path: str, timeout_s: float = 60.0):
+    def __init__(self, socket_path: str, timeout_s: float = 60.0,
+                 retry: RetryPolicy | None = CONNECT_RETRY):
         self.socket_path = socket_path
         self.timeout_s = timeout_s
+        self.retry = retry
         self._sock: socket.socket | None = None
         self._rfile = None
 
-    def connect(self) -> "ServeClient":
+    def _connect_once(self) -> socket.socket:
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         s.settimeout(self.timeout_s)
-        s.connect(self.socket_path)
+        try:
+            s.connect(self.socket_path)
+        except BaseException:
+            s.close()
+            raise
+        return s
+
+    def connect(self) -> "ServeClient":
+        if self.retry is None:
+            s = self._connect_once()
+        else:
+            s = retry_call(self._connect_once, policy=self.retry,
+                           classify=_connect_transient)
         self._sock = s
         self._rfile = s.makefile("rb")
         return self

@@ -53,27 +53,19 @@ def request_id(seed: int, i: int) -> str:
     return f"load_s{seed}_{i:03d}"
 
 
-def run_load(engine, spec: LoadSpec) -> dict:
-    """Drive one load run to drain; return the serving report.
-
-    Arrivals follow exponential inter-arrival times (a Poisson
-    process) pre-drawn from `spec.seed`; prompt contents/lengths and
-    decode budgets come from the same rng. Between engine steps the
-    driver submits every request whose arrival time has passed —
-    closed-loop, so a slow engine sees a burstier queue, exactly like
-    a real ingress under fixed offered load."""
+def build_workload(spec: LoadSpec):
+    """(arrivals, requests) for one spec — THE workload definition,
+    shared by the in-process driver (`run_load`) and the socket-target
+    driver (`run_load_socket`) so "the same spec" means the same
+    arrival schedule, prompts, budgets, and seeds on either path. The
+    rng draw ORDER is pinned (inter-arrivals, shared prefix, then per
+    request: tail length, tail, budget, seed) — reordering it would
+    silently shift every bench serving row across rounds."""
     rng = np.random.default_rng(spec.seed)
     inter = rng.exponential(1.0 / spec.rate_hz, spec.n_requests)
     arrivals = np.cumsum(inter)
     prefix = (rng.integers(1, spec.vocab, spec.shared_prefix_tokens)
               if spec.shared_prefix_tokens else None)
-    if prefix is not None and hasattr(engine, "tracer"):
-        # stamp the workload shape on the stream: `obs doctor` uses
-        # this to call out a shared-prefix run whose hit counter
-        # stayed at zero (a mis-configured prefix cache, not a slow one)
-        engine.tracer.event("serve_workload",
-                            shared_prefix_tokens=int(spec.shared_prefix_tokens),
-                            n_requests=spec.n_requests)
 
     def next_prompt() -> np.ndarray:
         tail = rng.integers(1, spec.vocab, rng.choice(spec.prompt_lens))
@@ -90,6 +82,26 @@ def run_load(engine, spec: LoadSpec) -> dict:
         )
         for i in range(spec.n_requests)
     ]
+    return arrivals, reqs
+
+
+def run_load(engine, spec: LoadSpec) -> dict:
+    """Drive one load run to drain; return the serving report.
+
+    Arrivals follow exponential inter-arrival times (a Poisson
+    process) pre-drawn from `spec.seed`; prompt contents/lengths and
+    decode budgets come from the same rng. Between engine steps the
+    driver submits every request whose arrival time has passed —
+    closed-loop, so a slow engine sees a burstier queue, exactly like
+    a real ingress under fixed offered load."""
+    arrivals, reqs = build_workload(spec)
+    if spec.shared_prefix_tokens and hasattr(engine, "tracer"):
+        # stamp the workload shape on the stream: `obs doctor` uses
+        # this to call out a shared-prefix run whose hit counter
+        # stayed at zero (a mis-configured prefix cache, not a slow one)
+        engine.tracer.event("serve_workload",
+                            shared_prefix_tokens=int(spec.shared_prefix_tokens),
+                            n_requests=spec.n_requests)
 
     t0 = time.monotonic()
     submitted = 0
@@ -181,4 +193,102 @@ def run_load(engine, spec: LoadSpec) -> dict:
         if spec.n_requests else 0.0,
         **attribution,
         "dominant_phase_p99": dominant,
+    }
+
+
+def run_load_socket(socket_path: str, spec: LoadSpec, *,
+                    request_timeout_s: float = 300.0,
+                    session_every: int = 0) -> dict:
+    """Drive a LIVE server or router over its unix socket with the same
+    seeded workload `run_load` uses in-process — the real wire path:
+    one connection per request, ServeClient connect-retry riding
+    through any supervised restarts, client-side TTFT/e2e clocks.
+
+    `session_every > 0` stamps `session_id = req_index // session_every`
+    on each request, so a router in front gets a deterministic
+    session-affinity workload to be sticky about.
+
+    The report carries the client-observable subset of `run_load`'s
+    keys (no engine internals — those belong to the server's own
+    telemetry), so `obs diff` reads both shapes."""
+    import threading
+
+    from hyperion_tpu.serve.client import ServeClient
+
+    arrivals, reqs = build_workload(spec)
+    results: list[dict] = [{} for _ in reqs]
+
+    def drive(i: int) -> None:
+        req = reqs[i]
+        doc = {
+            "id": req.id,
+            "prompt_ids": np.asarray(req.prompt_ids).tolist(),
+            "max_new_tokens": int(req.max_new_tokens),
+            "temperature": float(req.temperature),
+            "seed": int(req.seed),
+        }
+        if req.deadline_s is not None:
+            doc["deadline_s"] = float(req.deadline_s)
+        if session_every > 0:
+            doc["session_id"] = f"sess_{i // session_every}"
+        res = results[i]
+        sent = time.monotonic()
+        res["submitted_at"] = sent
+        try:
+            with ServeClient(socket_path,
+                             timeout_s=request_timeout_s) as c:
+                for rec in c.stream(**doc):
+                    ev = rec.get("event")
+                    if ev == "token" and rec.get("token") is not None:
+                        res.setdefault("first_token_at", time.monotonic())
+                        res["tokens"] = res.get("tokens", 0) + 1
+                    elif ev in ("done", "rejected", "timed_out",
+                                "error"):
+                        res["status"] = ev
+                        res["finished_at"] = time.monotonic()
+        except (OSError, ConnectionError) as e:
+            res["status"] = "error"
+            res["error"] = repr(e)
+            res["finished_at"] = time.monotonic()
+
+    t0 = time.monotonic()
+    threads: list[threading.Thread] = []
+    for i in range(spec.n_requests):
+        wait = t0 + arrivals[i] - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        t = threading.Thread(target=drive, args=(i,),
+                             name=f"load-{i}", daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=request_timeout_s)
+    elapsed = time.monotonic() - t0
+
+    done = [r for r in results if r.get("status") == "done"]
+    ttft_ms = [(r["first_token_at"] - r["submitted_at"]) * 1e3
+               for r in done if "first_token_at" in r]
+    e2e_ms = [(r["finished_at"] - r["submitted_at"]) * 1e3
+              for r in done if "finished_at" in r]
+    tokens = sum(r.get("tokens", 0) for r in done)
+    rejected = sum(1 for r in results
+                   if r.get("status") in ("rejected", "error"))
+    return {
+        "mode": "socket",
+        "requests": spec.n_requests,
+        "completed": len(done),
+        "rejected": rejected,
+        "timed_out": sum(1 for r in results
+                         if r.get("status") == "timed_out"),
+        "reject_rate": round(rejected / spec.n_requests, 4)
+        if spec.n_requests else 0.0,
+        "tokens": tokens,
+        "tokens_per_s": round(tokens / elapsed, 2) if elapsed > 0 else 0.0,
+        "ttft_p50_ms": round(percentile(ttft_ms, 50), 3) if ttft_ms else None,
+        "ttft_p99_ms": round(percentile(ttft_ms, 99), 3) if ttft_ms else None,
+        "e2e_p50_ms": round(percentile(e2e_ms, 50), 3) if e2e_ms else None,
+        "e2e_p99_ms": round(percentile(e2e_ms, 99), 3) if e2e_ms else None,
+        "elapsed_s": round(elapsed, 3),
+        "arrival_rate_hz": spec.rate_hz,
+        "shared_prefix_tokens": spec.shared_prefix_tokens,
     }

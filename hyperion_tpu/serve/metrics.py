@@ -244,3 +244,90 @@ class ServeMetrics:
             "journal_errors": int(c.get("serve_journal_errors", 0)),
             "dropped_sinks": int(c.get("serve_dropped_sinks", 0)),
         }
+
+
+class RouterMetrics:
+    """Fleet-level instruments for the replica router (serve/router.py)
+    — same registry/snapshot discipline as ServeMetrics, different
+    questions: not "how fast is one engine" but "how evenly is the
+    fleet loaded, how sticky is affinity, and how often did health
+    ejection fire". Unlike ServeMetrics (single engine-thread writer),
+    these instruments are hit from MANY relay threads concurrently, so
+    every mutation takes the lock — a lost increment here would skew
+    the fairness/scaleup numbers bench reads back from router_end."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        import threading
+
+        self.reg = registry or MetricsRegistry()
+        self._lock = threading.Lock()
+        for name in ("route_dispatched", "route_redispatched",
+                     "route_rejected", "route_completed",
+                     "route_affinity_lookups", "route_affinity_hits",
+                     "replica_ejections", "replica_readmits"):
+            self.reg.counter(name)
+        self.reg.gauge("fleet_ready").set(0.0)
+        self.reg.gauge("fleet_inflight").set(0.0)
+
+    def on_dispatch(self, replica: int, affinity_hit: bool,
+                    had_key: bool) -> None:
+        with self._lock:
+            self.reg.counter("route_dispatched").inc()
+            self.reg.counter(f"route_dispatched_replica_{replica}").inc()
+            if had_key:
+                lookups = self.reg.counter("route_affinity_lookups")
+                hits = self.reg.counter("route_affinity_hits")
+                lookups.inc()
+                if affinity_hit:
+                    hits.inc()
+                self.reg.gauge("route_affinity_hit_rate").set(
+                    hits.value / lookups.value)
+
+    def on_redispatch(self, reason: str) -> None:
+        with self._lock:
+            self.reg.counter("route_redispatched").inc()
+            self.reg.counter(f"route_redispatched_{reason}").inc()
+
+    def on_reject(self, reason: str) -> None:
+        with self._lock:
+            self.reg.counter("route_rejected").inc()
+            self.reg.counter(f"route_rejected_{reason}").inc()
+
+    def on_complete(self) -> None:
+        with self._lock:
+            self.reg.counter("route_completed").inc()
+
+    def on_eject(self) -> None:
+        with self._lock:
+            self.reg.counter("replica_ejections").inc()
+
+    def on_readmit(self) -> None:
+        with self._lock:
+            self.reg.counter("replica_readmits").inc()
+
+    def observe_fleet(self, ready: int, inflight: int) -> None:
+        with self._lock:
+            self.reg.gauge("fleet_ready").set(ready)
+            self.reg.gauge("fleet_inflight").set(inflight)
+
+    def summary(self) -> dict:
+        with self._lock:
+            snap = self.reg.snapshot()
+        c, g = snap["counters"], snap["gauges"]
+        share = {
+            k.removeprefix("route_dispatched_replica_"): int(v)
+            for k, v in c.items()
+            if k.startswith("route_dispatched_replica_")
+        }
+        return {
+            "dispatched": int(c.get("route_dispatched", 0)),
+            "redispatched": int(c.get("route_redispatched", 0)),
+            "rejected": int(c.get("route_rejected", 0)),
+            "completed": int(c.get("route_completed", 0)),
+            "affinity_lookups": int(c.get("route_affinity_lookups", 0)),
+            "affinity_hits": int(c.get("route_affinity_hits", 0)),
+            "affinity_hit_rate": g.get("route_affinity_hit_rate"),
+            "ejections": int(c.get("replica_ejections", 0)),
+            "readmits": int(c.get("replica_readmits", 0)),
+            "per_replica_dispatched": share,
+        }

@@ -49,6 +49,7 @@ REJECT_BAD_REQUEST = "bad_request"
 REJECT_DRAINING = "draining"        # queue closed for graceful shutdown
 REJECT_SHED = "shed_deadline"       # brownout: deadline unmeetable now
 REJECT_POISONED = "request_poisoned"  # crash-replay quarantine
+REJECT_NO_REPLICA = "no_replica"    # router: no dispatchable replica
 TIMED_OUT = "timed_out"
 
 

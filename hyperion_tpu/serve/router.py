@@ -1,0 +1,953 @@
+"""Replica-tier router — `hyperion route --replicas N --ckpt ...`.
+
+PRs 5–8 made ONE engine process a good fleet citizen: continuous
+batching, radix prefix reuse, per-request tracing, journal-replay crash
+safety. This module is the layer that multiplies it — the front-end
+process that turns "a server" into "a deployment" (ROADMAP item 3):
+
+  * **Fleet supervision** — N `hyperion serve` children, each with its
+    own unix socket, request journal, telemetry dir, and heartbeat,
+    run under the shared supervisor core (`hyperion_tpu/supervisor.py`)
+    with per-replica restart budgets and the heartbeat hang watchdog.
+    The router itself never touches a jax backend (all device work
+    lives in the children), so it stays responsive while a child is
+    wedged inside a dead one.
+  * **Health-aware dispatch** — least-loaded scoring over each
+    replica's heartbeat payload (active slots + queue depth, which the
+    engine publishes on serve, idle, AND terminal beats) plus the
+    dispatches the router has sent since that beat. A stale heartbeat,
+    a beat showing the replica left the serve phases (draining/done),
+    a connection error, or a child exit EJECTS the replica; it is
+    readmitted only on a fresh serve-phase beat newer than the
+    ejection (`serve/replica.py` is the state machine).
+  * **Session/prefix affinity** — requests sharing a `session_id`, or
+    a long common prompt prefix, route to the same replica so its
+    RadixPrefixCache keeps hitting. Stickiness yields when the sticky
+    target's load exceeds the least-loaded replica by more than the
+    slack (a hot session must not melt one replica while others idle).
+  * **Failover with exactly-once delivery** — every token record on
+    the wire carries its stream index `i`. When a replica dies
+    mid-stream the router re-dispatches the ORIGINAL request to
+    another replica: sampling is seed-deterministic (PRNG keys fold the
+    absolute position, never the wall clock), so the new replica
+    recomputes the identical stream and the router forwards only the
+    tokens the client has not seen. The dead replica's own journal
+    replays the request sink-less on restart — visible on its
+    telemetry as the resumed prefill the acceptance test asserts — so
+    no completion is ever lost, and none is ever delivered twice.
+  * **Backpressure composition** — a `queue_full` rejection from one
+    replica triggers re-dispatch to the next-best; when EVERY ready
+    replica says queue_full (or none is ready) past the dispatch
+    deadline, the router rejects with the standard `request_rejected`
+    vocabulary (`queue_full` / `no_replica`) on its own stream, so
+    fleet-wide saturation lands in the same doctor/diff tables as
+    single-engine backpressure.
+
+Failure matrix (SERVING.md "Replica tier" has the long version):
+replica crash → supervised restart + journal replay + router failover;
+router crash → replicas are orphaned children and the client stream is
+lost, but every replica journal is intact — a new router re-spawns
+them and each replays its owed work to completion; both crash →
+restart the router: same as router crash.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import itertools
+import json
+import subprocess
+import sys
+import threading
+import time
+from collections import OrderedDict
+from pathlib import Path
+
+from hyperion_tpu.serve.client import TERMINAL_EVENTS, ServeClient
+from hyperion_tpu.serve.metrics import RouterMetrics
+from hyperion_tpu.serve.queue import (
+    REJECT_BAD_REQUEST,
+    REJECT_DRAINING,
+    REJECT_NO_REPLICA,
+    REJECT_QUEUE_FULL,
+)
+from hyperion_tpu.serve.replica import READY, ReplicaHandle
+from hyperion_tpu.serve.server import _LineWriter
+from hyperion_tpu.utils.retry import RetryPolicy
+
+# connect policy for replica dispatch: generous enough to ride a
+# supervised restart (compile-cache warmups on real chips take seconds),
+# bounded so a replica that never comes back fails over instead of
+# hanging the relay
+DISPATCH_CONNECT_RETRY = RetryPolicy(tries=8, base_delay_s=0.05,
+                                     max_delay_s=1.0, deadline_s=20.0)
+
+
+class ClientGone(Exception):
+    """The CLIENT side of a relay died (its writer raised): the
+    replica is healthy — this must never be mistaken for a replica
+    failure, or one disconnecting client would eject the fleet."""
+
+
+class _ClientWriter:
+    """Wraps the client-facing writer so its failures raise ClientGone
+    instead of the OSError the failover path treats as replica death."""
+
+    def __init__(self, writer):
+        self._w = writer
+
+    def write(self, rec) -> None:
+        try:
+            self._w.write(rec)
+        except Exception as e:  # noqa: BLE001 — any client-side failure
+            raise ClientGone(repr(e)) from e
+
+
+class StreamDedup:
+    """Exactly-once filter over (possibly re-dispatched) token streams.
+
+    Token records carry their stream index `i` (serve/server.py stamps
+    it from the request's own token list). A failover re-dispatch
+    recomputes the stream from index 0 — deterministic seeds make it
+    bit-identical — and this filter drops everything the client already
+    received. Records without an index (an old replica build) fall back
+    to positional counting, which is still exact within one stream."""
+
+    def __init__(self):
+        self.delivered = 0
+
+    def admit(self, rec: dict) -> bool:
+        if rec.get("event") != "token":
+            return True
+        i = rec.get("i")
+        if not isinstance(i, int):
+            i = self.delivered
+        if i < self.delivered:
+            return False
+        self.delivered = i + 1
+        return True
+
+
+class RouterPolicy:
+    """Dispatch policy over a fleet of ReplicaHandles — pure host
+    logic (no sockets, no processes) so `tests/test_router.py` drives
+    it with fabricated heartbeats and zero jit compiles."""
+
+    def __init__(self, replicas: list[ReplicaHandle], *,
+                 affinity_slack: int = 4, affinity_cap: int = 512,
+                 prefix_tokens: int = 32, prefix_chars: int = 128):
+        self.replicas = list(replicas)
+        self.affinity_slack = affinity_slack
+        self.affinity_cap = affinity_cap
+        self.prefix_tokens = prefix_tokens
+        self.prefix_chars = prefix_chars
+        self._affinity: OrderedDict[str, int] = OrderedDict()
+        self._ever_ready: set[int] = set()
+        self._lock = threading.Lock()
+
+    # -------------------------------------------------------- affinity
+
+    def affinity_key(self, doc: dict) -> str | None:
+        """Stickiness key: an explicit session beats a prompt prefix; a
+        short prompt has no key (nothing worth pinning a replica for)."""
+        sid = doc.get("session_id")
+        if sid:
+            return f"s:{sid}"
+        ids = doc.get("prompt_ids")
+        if isinstance(ids, list) and len(ids) >= self.prefix_tokens:
+            head = ",".join(str(int(t)) for t in ids[:self.prefix_tokens])
+            return "p:" + hashlib.sha1(head.encode()).hexdigest()[:16]
+        prompt = doc.get("prompt")
+        if isinstance(prompt, str) and len(prompt) >= self.prefix_chars:
+            return "t:" + hashlib.sha1(
+                prompt[:self.prefix_chars].encode()).hexdigest()[:16]
+        return None
+
+    # -------------------------------------------------------- dispatch
+
+    def choose(self, doc: dict, exclude: set[int] | frozenset = frozenset(),
+               ) -> tuple[ReplicaHandle | None, dict]:
+        """Pick the dispatch target: the affinity-mapped replica when
+        it is ready and within `affinity_slack` of the least-loaded
+        score, else the least-loaded ready replica (ties broken by
+        index, deterministically). Returns (replica, meta) with the
+        replica's accounting already bumped — callers MUST `release`
+        when the stream ends. (None, meta) when no ready replica
+        remains outside `exclude`."""
+        with self._lock:
+            key = self.affinity_key(doc)
+            meta = {"had_key": key is not None, "affinity_hit": False}
+            ready = [r for r in self.replicas
+                     if r.state == READY and r.index not in exclude]
+            if not ready:
+                return None, meta
+            best = min(ready, key=lambda r: (r.load_score(), r.index))
+            target = best
+            if key is not None:
+                idx = self._affinity.get(key)
+                cand = next((r for r in ready if r.index == idx), None)
+                if cand is not None and cand.load_score() \
+                        <= best.load_score() + self.affinity_slack:
+                    target = cand
+                    meta["affinity_hit"] = True
+                self._affinity[key] = target.index
+                self._affinity.move_to_end(key)
+                while len(self._affinity) > self.affinity_cap:
+                    self._affinity.popitem(last=False)
+            target.inflight += 1
+            target.dispatched_since_beat += 1
+            target.dispatched_total += 1
+            return target, meta
+
+    def release(self, rep: ReplicaHandle) -> None:
+        with self._lock:
+            rep.inflight = max(0, rep.inflight - 1)
+
+    # ---------------------------------------------------------- health
+
+    def eject(self, rep: ReplicaHandle, reason: str,
+              now: float | None = None) -> bool:
+        """Mark a replica not-dispatchable; True on a transition."""
+        now = time.time() if now is None else now
+        with self._lock:
+            was = rep.state == READY
+            rep.eject(now, reason)
+            return was
+
+    def observe_beats(self, read_hb, now: float | None = None,
+                      stale_s: float = 10.0) -> list[tuple]:
+        """One health sweep: feed each replica its latest heartbeat and
+        apply the staleness rule. Returns transition tuples —
+        ("ready"|"readmitted", replica) and ("ejected", replica,
+        reason) — for the runtime to turn into events/metrics.
+        `read_hb(path) -> dict | None` is injectable for tests."""
+        now = time.time() if now is None else now
+        # file I/O OUTSIDE the lock: a slow heartbeat read (NFS base
+        # dir, big fleet) must never stall every relay's choose()
+        beats = [read_hb(rep.heartbeat_path) for rep in self.replicas]
+        out: list[tuple] = []
+        with self._lock:
+            for rep, hb in zip(self.replicas, beats):
+                tr = rep.observe_beat(hb, now)
+                if tr == "ready":
+                    kind = ("readmitted" if rep.index in self._ever_ready
+                            else "ready")
+                    self._ever_ready.add(rep.index)
+                    out.append((kind, rep))
+                elif tr == "ejected":
+                    # still beating, but draining/done: the handle
+                    # already flipped state; surface the transition
+                    out.append(("ejected", rep, rep.eject_reason))
+                reason = rep.check_stale(now, stale_s)
+                if reason is not None:
+                    out.append(("ejected", rep, reason))
+        return out
+
+    @property
+    def ready_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self.replicas if r.state == READY)
+
+    @property
+    def inflight_total(self) -> int:
+        with self._lock:
+            return sum(r.inflight for r in self.replicas)
+
+
+# ------------------------------------------------------------- runtime
+
+
+def replica_argv(args, rep: ReplicaHandle) -> list[str]:
+    """Child command for one replica: the serve surface the router
+    fronts, with the per-replica socket/journal wired in. Chaos plans
+    (`--replica-chaos IDX:PLAN`) attach only to their replica — the
+    deterministic kill-one-mid-stream drill."""
+    argv = [sys.executable, "-m", "hyperion_tpu.cli.main", "serve",
+            "--ckpt", args.ckpt,
+            "--socket", rep.socket_path,
+            "--journal", rep.journal_path,
+            "--max-len", str(args.max_len),
+            "--slots", str(args.slots),
+            "--block-size", str(args.block_size),
+            "--num-blocks", str(args.num_blocks),
+            "--queue-capacity", str(args.queue_capacity),
+            "--prefill-budget", str(args.prefill_budget),
+            "--max-new-default", str(args.max_new_default),
+            "--warmup-lens", args.warmup_lens,
+            "--heartbeat-every", str(args.replica_heartbeat_every),
+            "--drain-timeout", str(args.drain_timeout)]
+    argv.append("--prefix-cache" if args.prefix_cache
+                else "--no-prefix-cache")
+    if args.no_tokenizer:
+        argv.append("--no-tokenizer")
+    else:
+        argv += ["--tokenizer-dir", args.tokenizer_dir]
+    if args.eos_id is not None:
+        argv += ["--eos-id", str(args.eos_id)]
+    plan = dict(p.split(":", 1) for p in (args.replica_chaos or [])
+                if ":" in p).get(str(rep.index))
+    if plan:
+        argv += ["--chaos", plan]
+    return argv
+
+
+class Router:
+    """The running fleet: supervisor thread per replica, a heartbeat
+    monitor, and one relay thread per in-flight request."""
+
+    def __init__(self, args, tracer, hb,
+                 metrics: RouterMetrics | None = None,
+                 child_argv_fn=replica_argv):
+        self.args = args
+        self.tracer = tracer
+        self.hb = hb
+        self.metrics = metrics or RouterMetrics()
+        # injectable child command (tests run the router runtime over
+        # jax-free fake replicas that speak the wire protocol)
+        self._child_argv_fn = child_argv_fn
+        base = Path(args.base_dir)
+        self.replicas = [ReplicaHandle.under(base, i)
+                         for i in range(args.replicas)]
+        self.policy = RouterPolicy(
+            self.replicas,
+            affinity_slack=args.affinity_slack,
+            prefix_tokens=args.affinity_prefix)
+        self._procs: dict[int, subprocess.Popen] = {}
+        self._sup_threads: list[threading.Thread] = []
+        self._req_threads: list[threading.Thread] = []
+        self._active: set[str] = set()
+        self._req_lock = threading.Lock()
+        self._rids = itertools.count()
+        self._stopping = threading.Event()   # no new work
+        self._hard_stop = threading.Event()  # abandon in-flight relays
+        self._mon_stop = threading.Event()
+        self._mon_thread: threading.Thread | None = None
+
+    # ----------------------------------------------------------- fleet
+
+    def _log(self, msg: str) -> None:
+        # stderr always: stdout is the client's JSONL wire stream
+        print(msg, file=sys.stderr, flush=True)
+
+    def _notify_eject(self, rep: ReplicaHandle, reason: str) -> None:
+        """THE ejection emission — metric (unless this is the planned
+        shutdown taking everyone out), event, stderr line. Callers must
+        only invoke it for a transition that actually happened."""
+        if not self._stopping.is_set():
+            self.metrics.on_eject()
+        self.tracer.event("replica_ejected", replica=rep.index,
+                          reason=reason)
+        self._log(f"[route] replica {rep.index} ejected: {reason}")
+
+    def _eject(self, rep: ReplicaHandle, reason: str) -> None:
+        if self.policy.eject(rep, reason):
+            self._notify_eject(rep, reason)
+
+    def _supervise_one(self, rep: ReplicaHandle) -> None:
+        from hyperion_tpu.supervisor import (
+            Decision,
+            heartbeat_watchdog,
+            supervise_loop,
+        )
+
+        try:
+            err_fd = sys.stderr.fileno()
+        except Exception:  # noqa: BLE001
+            err_fd = 2  # pytest capture replaces sys.stderr objects
+        runner = heartbeat_watchdog(
+            rep.heartbeat_path, self.args.hang_timeout, log=self._log,
+            on_spawn=lambda p: self._procs.__setitem__(rep.index, p),
+            # the children's stdout must never reach the router's —
+            # chaos chatter and stray prints go where supervisor logs go
+            popen_kwargs={"stdout": err_fd},
+        )
+
+        def run(argv: list, env: dict) -> int:
+            env = {**env,
+                   # the heartbeat IS the router's control plane: force
+                   # each child's stream on, to its own dir, whatever
+                   # the operator chose for the router's telemetry
+                   "HYPERION_TELEMETRY": rep.telemetry_path,
+                   "HYPERION_REPLICA": str(rep.index)}
+            env.pop("HYPERION_HEARTBEAT", None)
+            return runner(argv, env)
+
+        def decide(rc: int) -> Decision:
+            self._eject(rep, f"child exit {rc}")
+            self.tracer.event("replica_exit", replica=rep.index, rc=rc)
+            if self._stopping.is_set():
+                return Decision.stop(0)
+            rep.restarts += 1
+            # restart immediately: an ejected replica costs fleet
+            # capacity every second, and the journal replay it owes is
+            # idempotent — backoff belongs to crash LOOPS, which the
+            # per-replica restart budget already bounds
+            return Decision.restart(immediate=rep.restarts <= 1)
+
+        rc = supervise_loop(
+            self._child_argv_fn(self.args, rep), decide=decide,
+            max_restarts=self.args.max_restarts, run_child=run,
+            label=f"replica{rep.index}", log=self._log)
+        # always logged (the eject below is silent when the relay's
+        # connection error ejected first): a supervisor that stops
+        # while the router is still serving is a fact the operator —
+        # and any flake hunt — needs on stderr
+        self._log(f"[route] replica {rep.index} supervisor done "
+                  f"(rc {rc}, restarts {rep.restarts}, "
+                  f"stopping={self._stopping.is_set()})")
+        self._eject(rep, f"supervisor finished (rc {rc})")
+
+    def start(self) -> None:
+        self.tracer.event(
+            "router_start", replicas=len(self.replicas),
+            slots=self.args.slots, max_len=self.args.max_len,
+            stale_s=self.args.stale_s,
+            affinity_prefix=self.args.affinity_prefix)
+        self.hb.pulse(phase="route_spawn", ready=0)
+        for rep in self.replicas:
+            rep.dir.mkdir(parents=True, exist_ok=True)
+            t = threading.Thread(target=self._supervise_one, args=(rep,),
+                                 name=f"replica{rep.index}-sup",
+                                 daemon=True)
+            t.start()
+            self._sup_threads.append(t)
+        self._mon_thread = threading.Thread(
+            target=self._monitor, name="route-monitor", daemon=True)
+        self._mon_thread.start()
+
+    def _monitor(self, poll_s: float = 0.25) -> None:
+        from hyperion_tpu.obs.heartbeat import read_heartbeat
+
+        last_snap = 0.0
+        while not self._mon_stop.is_set():
+            for tr in self.policy.observe_beats(
+                    read_heartbeat, stale_s=self.args.stale_s):
+                if tr[0] in ("ready", "readmitted"):
+                    rep = tr[1]
+                    if tr[0] == "readmitted":
+                        self.metrics.on_readmit()
+                    self.tracer.event(f"replica_{tr[0]}",
+                                      replica=rep.index,
+                                      restarts=rep.restarts)
+                    self._log(f"[route] replica {rep.index} {tr[0]} "
+                              f"(pid {rep.hb_pid})")
+                else:
+                    # observe_beats already flipped the handle's state
+                    # (the tuple IS the transition) — notify directly,
+                    # the idempotent _eject would swallow it
+                    self._notify_eject(tr[1], tr[2])
+            ready = self.policy.ready_count
+            inflight = self.policy.inflight_total
+            self.metrics.observe_fleet(ready, inflight)
+            self.hb.beat(step=self.metrics.summary()["dispatched"],
+                         phase="route", active=inflight, queue=0,
+                         ready=ready)
+            now = time.monotonic()
+            if now - last_snap >= 5.0:
+                self.tracer.snapshot(self.metrics.reg)
+                last_snap = now
+            self._mon_stop.wait(poll_s)
+
+    def wait_ready(self, n: int = 1, timeout_s: float = 120.0) -> bool:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            if self.policy.ready_count >= n:
+                return True
+            if self._hard_stop.is_set():
+                return False
+            time.sleep(0.1)
+        return self.policy.ready_count >= n
+
+    # --------------------------------------------------------- intake
+
+    @property
+    def requests_idle(self) -> bool:
+        with self._req_lock:
+            return not self._active
+
+    def begin_drain(self) -> None:
+        if not self._stopping.is_set():
+            self._stopping.set()
+            self.tracer.event("router_draining",
+                              inflight=self.policy.inflight_total)
+
+    def submit_line(self, line: str, writer) -> threading.Thread | None:
+        """Parse the routing envelope of one wire line and hand it to a
+        relay thread. Malformed lines reject immediately with the
+        standard vocabulary — never an exception on the intake path."""
+        try:
+            doc = json.loads(line)
+            if not isinstance(doc, dict):
+                raise ValueError("request line must be a JSON object")
+        except (json.JSONDecodeError, ValueError) as e:
+            self.metrics.on_reject(REJECT_BAD_REQUEST)
+            self.tracer.event("request_rejected",
+                              request=f"unparsed_{next(self._rids)}",
+                              reason=REJECT_BAD_REQUEST,
+                              error=str(e)[:200], queued_s=0.0)
+            writer.write({"id": None, "event": "error",
+                          "error": f"bad json: {e}"})
+            return None
+        if not doc.get("id"):
+            doc["id"] = f"route_{next(self._rids)}"
+        rid = str(doc["id"])
+        if self._stopping.is_set():
+            self._reject(rid, REJECT_DRAINING, time.monotonic(), writer)
+            return None
+        with self._req_lock:
+            self._active.add(rid)
+        t = threading.Thread(target=self._relay, args=(rid, doc, writer),
+                             name=f"relay-{rid}", daemon=True)
+        t.start()
+        if len(self._req_threads) > 256:
+            # a long-lived router must not accumulate dead thread
+            # objects one per request served
+            self._req_threads = [x for x in self._req_threads
+                                 if x.is_alive()]
+        self._req_threads.append(t)
+        return t
+
+    def _reject(self, rid: str, reason: str, submitted: float,
+                writer) -> None:
+        self.metrics.on_reject(reason)
+        self.tracer.event(
+            "request_rejected", request=rid, reason=reason,
+            queued_s=round(max(0.0, time.monotonic() - submitted), 6))
+        writer.write({"id": rid, "event": "rejected", "reason": reason})
+
+    # ---------------------------------------------------------- relay
+
+    def _relay(self, rid: str, doc: dict, writer) -> None:
+        try:
+            self._relay_inner(rid, doc, _ClientWriter(writer))
+        except ClientGone as e:
+            # the CLIENT vanished mid-stream: its request dies with it
+            # (nothing left to deliver to), the replica keeps serving —
+            # the engine's own dropped-sink handling finishes the slot
+            self.tracer.event("client_disconnected", request=rid,
+                              error=str(e)[:200])
+        except Exception as e:  # noqa: BLE001 — a relay bug must reject
+            # its request, never silently strand the client's stream
+            try:
+                self._reject(rid, REJECT_BAD_REQUEST, time.monotonic(),
+                             writer)
+            except Exception:  # noqa: BLE001 — reject write to a dead
+                pass           # client must not mask the real error
+            self._log(f"[route] relay {rid} failed: {e!r}")
+        finally:
+            with self._req_lock:
+                self._active.discard(rid)
+
+    def _relay_inner(self, rid: str, doc: dict, writer) -> None:
+        submitted = time.monotonic()
+        dedup = StreamDedup()
+        crashed: set[int] = set()   # replicas this request already
+        #                             visited: their journals hold its
+        #                             admit record — never go back
+        qfull: set[int] = set()
+        deadline = submitted + self.args.dispatch_timeout
+        redispatches = 0
+        saw_qfull = False
+        backoff = 0.05
+        while True:
+            if self._hard_stop.is_set():
+                self._reject(rid, REJECT_DRAINING, submitted, writer)
+                return
+            rep, meta = self.policy.choose(doc, exclude=crashed | qfull)
+            if rep is None:
+                if time.monotonic() > deadline:
+                    self._reject(
+                        rid,
+                        REJECT_QUEUE_FULL if saw_qfull
+                        else REJECT_NO_REPLICA,
+                        submitted, writer)
+                    return
+                # every ready replica rejected queue_full this sweep:
+                # clear the sweep set and retry after a breath — the
+                # fleet may drain, and the deadline bounds the wait
+                qfull.clear()
+                time.sleep(backoff)
+                backoff = min(backoff * 2.0, 0.5)
+                continue
+            self.metrics.on_dispatch(rep.index, meta["affinity_hit"],
+                                     meta["had_key"])
+            self.tracer.event(
+                "route_dispatch", request=rid, replica=rep.index,
+                affinity=meta["affinity_hit"], redispatch=redispatches)
+            try:
+                outcome, terminal = self._stream_from(rep, doc, dedup,
+                                                      writer)
+            except (OSError, ConnectionError, ValueError) as e:
+                # mid-stream death (or connect that never came up):
+                # eject, fail over. The renewed deadline is deliberate —
+                # this request was admitted somewhere; dropping it now
+                # would turn one replica crash into client-visible loss
+                self._eject(rep, f"connection error "
+                                 f"({e.__class__.__name__})")
+                crashed.add(rep.index)
+                redispatches += 1
+                self.metrics.on_redispatch("replica_lost")
+                self.tracer.event("route_redispatch", request=rid,
+                                  from_replica=rep.index,
+                                  reason="replica_lost",
+                                  delivered=dedup.delivered)
+                deadline = max(deadline, time.monotonic()
+                               + self.args.dispatch_timeout)
+                continue
+            finally:
+                # whatever ends the attempt — terminal, failover, or a
+                # relay bug propagating out — the load accounting must
+                # not leak an inflight count
+                self.policy.release(rep)
+            if outcome == "queue_full":
+                saw_qfull = True
+                qfull.add(rep.index)
+                redispatches += 1
+                self.metrics.on_redispatch(REJECT_QUEUE_FULL)
+                self.tracer.event("route_redispatch", request=rid,
+                                  from_replica=rep.index,
+                                  reason=REJECT_QUEUE_FULL)
+                continue
+            self.metrics.on_complete()
+            self.tracer.event(
+                "route_complete", request=rid, replica=rep.index,
+                status=outcome, tokens=dedup.delivered,
+                redispatches=redispatches,
+                e2e_s=round(time.monotonic() - submitted, 6))
+            return
+
+    def _stream_from(self, rep: ReplicaHandle, doc: dict,
+                     dedup: StreamDedup, writer) -> tuple[str, dict]:
+        """One dispatch attempt: open the replica stream, forward
+        deduplicated records to the client. Returns (outcome, terminal
+        record) where outcome is the terminal event name or
+        "queue_full" (the one rejection the router retries elsewhere
+        instead of forwarding). Raises OSError/ConnectionError on a
+        dead replica — the caller's failover path."""
+        with ServeClient(rep.socket_path,
+                         timeout_s=self.args.stream_timeout,
+                         retry=DISPATCH_CONNECT_RETRY) as client:
+            for rec in client.stream(**doc):
+                ev = rec.get("event")
+                if ev == "token":
+                    if dedup.admit(rec):
+                        writer.write(rec)
+                    continue
+                if ev in TERMINAL_EVENTS:
+                    if ev == "rejected" \
+                            and rec.get("reason") == REJECT_QUEUE_FULL:
+                        return "queue_full", rec
+                    writer.write(rec)
+                    return ev, rec
+                # non-terminal bookkeeping records pass through
+                writer.write(rec)
+        raise ConnectionError("replica stream ended without a terminal "
+                              "event")
+
+    # ------------------------------------------------------- shutdown
+
+    def shutdown(self) -> dict:
+        """Drain the fleet: SIGTERM every child (their own graceful
+        drain finishes in-flight work and close-cleans the journal),
+        join the supervisors, stop the monitor, stamp `router_end`."""
+        self._stopping.set()
+
+        def signal_children(kill: bool = False) -> None:
+            for rep in self.replicas:
+                proc = self._procs.get(rep.index)
+                if proc is not None and proc.poll() is None:
+                    try:
+                        proc.kill() if kill else proc.terminate()
+                    except OSError:
+                        pass
+
+        # a child may still be mid-spawn: wait briefly for every live
+        # supervisor to register its Popen, or the signal pass below
+        # misses it and the join runs out its whole budget before the
+        # kill fallback can reach the late arrival
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 5.0 and any(
+                t.is_alive() and self._procs.get(rep.index) is None
+                for t, rep in zip(self._sup_threads, self.replicas)):
+            time.sleep(0.05)
+        signal_children()
+        join_s = self.args.drain_timeout + 10.0
+        t0 = time.monotonic()
+        for t in self._sup_threads:
+            t.join(timeout=max(0.5, join_s - (time.monotonic() - t0)))
+        signal_children(kill=True)
+        for t in self._sup_threads:
+            t.join(timeout=5.0)
+        self._mon_stop.set()
+        if self._mon_thread is not None:
+            self._mon_thread.join(timeout=5.0)
+        summary = self.metrics.summary()
+        summary["per_replica_restarts"] = {
+            str(r.index): r.restarts for r in self.replicas}
+        self.tracer.snapshot(self.metrics.reg)
+        # the full summary rides the terminal event — nested per-replica
+        # dicts included, they are what the bench probe reads back for
+        # its fairness and affinity keys
+        self.tracer.event("router_end", **summary)
+        self.hb.close(phase="done",
+                      dispatched=summary["dispatched"],
+                      completed=summary["completed"])
+        return summary
+
+
+# --------------------------------------------------------- front-ends
+
+
+def route_jsonl(router: Router, infile, outfile,
+                drain=None, hard_stop=None) -> dict:
+    """stdin/stdout mode: a reader thread feeds relay threads; the
+    router drains on EOF (same composition contract as serve_jsonl —
+    the smoke script pipes into it)."""
+    out = _LineWriter(outfile)
+    eof = threading.Event()
+
+    def reader():
+        try:
+            for line in infile:
+                line = line.strip()
+                if not line:
+                    continue
+                router.submit_line(line, out)
+        finally:
+            eof.set()
+
+    t = threading.Thread(target=reader, name="route-stdin", daemon=True)
+    t.start()
+    while True:
+        if hard_stop is not None and hard_stop.is_set():
+            router._hard_stop.set()
+            break
+        if drain is not None and drain.is_set():
+            router.begin_drain()
+        if eof.is_set() and router.requests_idle:
+            break
+        time.sleep(0.02)
+    t.join(timeout=5)
+    return router.shutdown()
+
+
+def route_socket(router: Router, socket_path: str,
+                 drain=None, hard_stop=None, ready=None) -> dict:
+    """Unix-socket mode: each connection's requests relay back over its
+    own writer — the same transport contract as serve_socket, one
+    level up."""
+    import socketserver
+
+    from hyperion_tpu.serve.server import prepare_socket_path
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            writer = _LineWriter(self.wfile)
+            mine: list[threading.Thread] = []
+            for raw in self.rfile:
+                try:
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line:
+                        continue
+                    t = router.submit_line(line, writer)
+                    if t is not None:
+                        mine.append(t)
+                except Exception:  # noqa: BLE001 — a dead client's
+                    break          # problem, never the router's
+            for t in mine:
+                t.join(timeout=router.args.stream_timeout)
+
+    class Server(socketserver.ThreadingMixIn,
+                 socketserver.UnixStreamServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+        def handle_error(self, request, client_address):
+            router.tracer.event("client_error",
+                                client=str(client_address))
+
+    prepare_socket_path(socket_path)
+    srv = Server(socket_path, Handler)
+    acceptor = threading.Thread(target=srv.serve_forever,
+                                name="route-accept", daemon=True)
+    acceptor.start()
+    if ready is not None:
+        ready.set()
+    try:
+        while True:
+            if hard_stop is not None and hard_stop.is_set():
+                router._hard_stop.set()
+                break
+            if drain is not None and drain.is_set():
+                router.begin_drain()
+                if router.requests_idle:
+                    break
+            time.sleep(0.05)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        try:
+            Path(socket_path).unlink()
+        except OSError:
+            pass
+    return router.shutdown()
+
+
+# --------------------------------------------------------------- CLI
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hyperion route",
+        description="replica-tier serving: N supervised engine "
+                    "replicas behind a health-aware, prefix-affine "
+                    "router (stdin/JSONL by default, --socket for a "
+                    "local unix socket)")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="engine replicas to spawn and supervise")
+    p.add_argument("--base-dir", default="data/router",
+                   help="fleet root: replica_<i>/ holds each child's "
+                        "socket, journal, telemetry, heartbeat; the "
+                        "router's own telemetry.jsonl sits beside them "
+                        "(`obs doctor <base-dir>` renders the fleet)")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="router front-end socket (default: stdin/stdout)")
+    # ---- dispatch policy ----
+    p.add_argument("--affinity-prefix", type=int, default=32,
+                   help="prompt tokens hashed into the prefix-affinity "
+                        "key: requests sharing this long a prefix (or a "
+                        "session_id) stick to one replica so its radix "
+                        "cache keeps hitting")
+    p.add_argument("--affinity-slack", type=int, default=4,
+                   help="load headroom an affinity target may carry "
+                        "over the least-loaded replica before "
+                        "stickiness yields")
+    p.add_argument("--dispatch-timeout", type=float, default=60.0,
+                   help="seconds a request may wait for a dispatchable "
+                        "replica (renewed after a failover) before the "
+                        "router rejects it")
+    p.add_argument("--stream-timeout", type=float, default=300.0,
+                   help="per-read socket timeout on a replica stream")
+    # ---- fleet health ----
+    p.add_argument("--stale-s", type=float, default=10.0,
+                   help="heartbeat age that ejects a replica from "
+                        "dispatch (readmission needs a fresh serve-"
+                        "phase beat)")
+    p.add_argument("--hang-timeout", type=float, default=60.0,
+                   help="heartbeat age at which the supervisor SIGKILLs "
+                        "a wedged child (0 = off)")
+    p.add_argument("--max-restarts", type=int, default=2,
+                   help="per-replica restart budget before its "
+                        "supervisor gives up")
+    p.add_argument("--ready-timeout", type=float, default=180.0,
+                   help="seconds to wait for replicas to come up before "
+                        "serving")
+    p.add_argument("--min-ready", type=int, default=1,
+                   help="replicas that must be READY before the router "
+                        "starts accepting requests (deterministic "
+                        "spread for drills/benches; default 1 = serve "
+                        "as soon as anything can)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="graceful-drain window, router AND replicas")
+    p.add_argument("--replica-chaos", action="append", default=None,
+                   metavar="IDX:PLAN",
+                   help="attach a chaos plan (testing/chaos.py grammar) "
+                        "to one replica, e.g. 0:crash@tick=2 — the "
+                        "kill-one-mid-stream drill")
+    # ---- replica engine surface (forwarded to each child) ----
+    p.add_argument("--ckpt", required=True,
+                   help="gathered-export .npz every replica serves")
+    p.add_argument("--tokenizer-dir", default="data/tokenizer")
+    p.add_argument("--no-tokenizer", action="store_true")
+    p.add_argument("--eos-id", type=int, default=None)
+    p.add_argument("--max-len", type=int, default=256)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-blocks", type=int, default=0)
+    p.add_argument("--prefix-cache", action=argparse.BooleanOptionalAction,
+                   default=True)
+    p.add_argument("--queue-capacity", type=int, default=64)
+    p.add_argument("--prefill-budget", type=int, default=512)
+    p.add_argument("--max-new-default", type=int, default=32)
+    p.add_argument("--warmup-lens", default="8,32")
+    p.add_argument("--replica-heartbeat-every", type=int, default=5,
+                   help="replica beat cadence in ticks — the router's "
+                        "load scores are only as fresh as these beats")
+    return p
+
+
+def main(argv=None) -> int:
+    import os
+    import signal
+
+    argv = sys.argv[1:] if argv is None else list(argv)
+    args = build_parser().parse_args(argv)
+
+    from hyperion_tpu.obs import heartbeat as obs_heartbeat
+    from hyperion_tpu.obs import trace as obs_trace
+
+    base = Path(args.base_dir)
+    base.mkdir(parents=True, exist_ok=True)
+    # the router's stream defaults ON (it is the fleet's control-plane
+    # record); HYPERION_TELEMETRY=0 still silences it. proc=0 skips the
+    # dist lookup — the router must never touch a jax backend.
+    tracer = obs_trace.from_env(
+        str(base / "telemetry.jsonl"),
+        run=f"route_{int(time.time())}", proc=0, enabled_by_default=True)
+    hb = obs_heartbeat.Heartbeat.for_tracer(tracer, every=25)
+    router = Router(args, tracer, hb)
+    router.start()
+    need = max(1, min(args.min_ready, args.replicas))
+    if not router.wait_ready(need, timeout_s=args.ready_timeout):
+        print(f"[route] fewer than {need} replica(s) ready within "
+              f"{args.ready_timeout:.0f}s — check "
+              f"{base}/replica_*/telemetry.jsonl", file=sys.stderr)
+        router._hard_stop.set()
+        router.shutdown()
+        tracer.close()
+        return 3
+
+    drain_evt = threading.Event()
+    hard_evt = threading.Event()
+
+    def _on_signal(signum, frame):
+        if drain_evt.is_set():
+            hard_evt.set()
+        else:
+            print(f"[route] signal {signum}: draining (signal again to "
+                  "stop now)", file=sys.stderr)
+        drain_evt.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_signal)
+        except ValueError:
+            pass
+
+    print(f"[route] {router.policy.ready_count}/{args.replicas} "
+          f"replica(s) ready under {base}", file=sys.stderr)
+    try:
+        if args.socket:
+            print(f"[route] listening on {args.socket}", file=sys.stderr)
+            summary = route_socket(router, args.socket,
+                                   drain=drain_evt, hard_stop=hard_evt)
+        else:
+            summary = route_jsonl(router, sys.stdin, sys.stdout,
+                                  drain=drain_evt, hard_stop=hard_evt)
+    except KeyboardInterrupt:
+        summary = router.shutdown()
+    print(f"[route] done: {summary['dispatched']} dispatched, "
+          f"{summary['completed']} completed, "
+          f"{summary['redispatched']} re-dispatched, "
+          f"{summary['rejected']} rejected; per-replica "
+          f"{summary['per_replica_dispatched']}", file=sys.stderr)
+    tracer.close()
+    if tracer.enabled:
+        print(f"[route] fleet evidence: `python -m hyperion_tpu.cli.main "
+              f"obs doctor {base}`", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

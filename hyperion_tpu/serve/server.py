@@ -59,7 +59,13 @@ def event_record(ev, tok=None) -> dict:
                 "n_tokens": len(req.tokens), "recovered": True}
     if ev.kind != "token":
         return {"id": req.id, "event": ev.kind, "reason": ev.reason}
-    rec: dict = {"id": req.id, "event": "token", "token": ev.token}
+    # `i` is the token's index in the request's stream (the engine
+    # appends before the sink runs, so the newest token is the last):
+    # the router's failover dedup keys on it — a re-dispatched request
+    # recomputes the identical seeded stream and the router forwards
+    # only indices the client has not seen
+    rec: dict = {"id": req.id, "event": "token", "token": ev.token,
+                 "i": len(req.tokens) - 1}
     if tok is not None and ev.token is not None:
         try:
             rec["text"] = tok.decode([ev.token])
@@ -536,11 +542,20 @@ def main(argv=None) -> int:
         tok = ByteBPE.load(args.tokenizer_dir)
 
     attempt = int(os.environ.get("HYPERION_ATTEMPT", "0") or 0)
+    # under a router, each replica stamps its index onto every record
+    # (the tracer's proc field) and heartbeat — the fleet doctor and
+    # the timeline's replica tags read it back
+    replica = os.environ.get("HYPERION_REPLICA", "")
+    replica_idx = int(replica) if replica.isdigit() else None
+    run_tag = f"serve_r{replica_idx}" if replica_idx is not None \
+        else "serve"
     tracer = obs_trace.from_env(
-        "data/telemetry.jsonl", run=f"serve_{int(time.time())}")
+        "data/telemetry.jsonl", run=f"{run_tag}_{int(time.time())}",
+        proc=replica_idx)
     hb = obs_heartbeat.Heartbeat.for_tracer(
         tracer, every=args.heartbeat_every,
-        static={"attempt": attempt})
+        static=({"attempt": attempt, "replica": replica_idx}
+                if replica_idx is not None else {"attempt": attempt}))
     hb.pulse(phase="load")
     journal = None
     chaos = None

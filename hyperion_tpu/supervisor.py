@@ -104,17 +104,25 @@ def strip_flags(argv: list[str], bare: set[str],
 def heartbeat_watchdog(hb_path: str | Path | None, stale_s: float,
                        poll_s: float = 1.0,
                        log: Callable[[str], None] = print,
+                       on_spawn: Callable | None = None,
+                       popen_kwargs: dict | None = None,
                        ) -> Callable[[list, dict], int]:
     """A `run_child` that SIGKILLs the child when its heartbeat file
     goes stale — the live half of the doctor's hung verdict. Returns
     `RC_HUNG` for a watchdog kill so the policy can name it. With no
     heartbeat path (telemetry off) it degrades to a plain wait: a hung
-    child then hangs the supervisor too, which is at least visible."""
+    child then hangs the supervisor too, which is at least visible.
+    `on_spawn(proc)` observes each child Popen (the router uses it to
+    keep a signalling handle on every replica); `popen_kwargs` extends
+    the spawn (the router redirects replica stdout to stderr so chaos
+    chatter never lands on the client wire)."""
     hb_path = Path(hb_path) if hb_path else None
 
     def _run(argv: list[str], env: dict) -> int:
         start_wall = time.time()
-        proc = subprocess.Popen(argv, env=env)
+        proc = subprocess.Popen(argv, env=env, **(popen_kwargs or {}))
+        if on_spawn is not None:
+            on_spawn(proc)
         while True:
             rc = proc.poll()
             if rc is not None:

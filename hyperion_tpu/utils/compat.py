@@ -57,3 +57,16 @@ def axis_size(axis_name) -> int:
     if hasattr(jax.lax, "axis_size"):
         return jax.lax.axis_size(axis_name)
     return jax.lax.psum(1, axis_name)
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """`pltpu.CompilerParams(...)` across the jax 0.5 rename: newer
+    releases call it CompilerParams, 0.4.x (this container's 0.4.37)
+    only has the original TPUCompilerParams. Same fields either way
+    (dimension_semantics et al.), so the kernels pass kwargs through."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

@@ -183,3 +183,105 @@ print(f"[serve_smoke] OK: kill-and-resume — {len(got)} tokens exactly "
       "once across 2 process lives, bit-identical to the uninterrupted "
       "run, replay visible on the stream")
 PY
+
+# 7. replica-tier round trip: `hyperion route` over 2 supervised
+#    replicas; replica 0 crashes HARD mid-stream (chaos crash@tick=2)
+#    while requests are in flight. The router fails over in-flight
+#    streams to replica 1 (seed-deterministic recompute + token-index
+#    dedup), the supervisor restarts replica 0, and its journal replays
+#    the owed work sink-less. The combined client stream must be
+#    complete (every request exactly one done) and duplicate-free
+#    (token indices strictly increasing per request), bit-identical to
+#    the single-engine run of the same prompts.
+ROUTEREQS="$WORK/route_reqs.jsonl"
+python - "$ROUTEREQS" <<'PY'
+import json
+import sys
+
+with open(sys.argv[1], "w") as f:
+    for i in range(8):
+        f.write(json.dumps({"id": f"m{i}",
+                            "prompt_ids": [3 + i, 4, 5, 6, 7, 8],
+                            "max_new_tokens": 10}) + "\n")
+PY
+
+# single-engine reference for bit-identity
+cat "$ROUTEREQS" \
+  | python -m hyperion_tpu.cli.main serve \
+      --ckpt "$WORK/llama.npz" --no-tokenizer \
+      --max-len 64 --slots 2 --warmup-lens 8 \
+      > "$WORK/route_ref.jsonl"
+
+# the fleet run: --min-ready 2 so dispatch spreads over both replicas
+# before the drill fires (replica 0 must hold streams when it dies);
+# stdin stays open a beat so the EOF drain never races the crash
+(cat "$ROUTEREQS"; sleep 2) \
+  | python -m hyperion_tpu.cli.main route \
+      --replicas 2 --min-ready 2 --ckpt "$WORK/llama.npz" --no-tokenizer \
+      --base-dir "$WORK/fleet" --max-len 64 --slots 2 --warmup-lens 8 \
+      --replica-heartbeat-every 1 --replica-chaos 0:crash@tick=2 \
+      > "$WORK/route_responses.jsonl"
+
+# the dead replica's journal still owes its in-flight requests (the
+# router delivered them via failover, but THIS replica's WAL doesn't
+# know that): drain it the way a restarted replica would — the journal
+# replay and its resumed prefills land on the replica's own telemetry
+# stream, deterministically, however the in-run restart raced the
+# router's drain window
+cat /dev/null \
+  | env HYPERION_TELEMETRY="$WORK/fleet/replica_0/telemetry.jsonl" \
+    python -m hyperion_tpu.cli.main serve \
+      --ckpt "$WORK/llama.npz" --no-tokenizer \
+      --max-len 64 --slots 2 --warmup-lens 8 \
+      --journal "$WORK/fleet/replica_0/journal.jsonl" \
+      > /dev/null
+
+python - "$WORK/route_ref.jsonl" "$WORK/route_responses.jsonl" \
+         "$WORK/fleet" <<'PY'
+import json
+import sys
+from pathlib import Path
+
+
+def streams(path):
+    toks, dones = {}, {}
+    for line in open(path):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("event") == "token" and rec.get("token") is not None:
+            toks.setdefault(rec["id"], []).append(
+                (rec.get("i"), rec["token"]))
+        elif rec.get("event") == "done":
+            dones[rec["id"]] = dones.get(rec["id"], 0) + 1
+    return toks, dones
+
+
+ref_toks, ref_dones = streams(sys.argv[1])
+got_toks, got_dones = streams(sys.argv[2])
+ids = {f"m{i}" for i in range(8)}
+assert set(got_dones) == ids and all(v == 1 for v in got_dones.values()), \
+    f"expected one done per request, got {got_dones}"
+for rid in ids:
+    idx = [i for i, _ in got_toks[rid]]
+    assert idx == sorted(set(idx)) == list(range(len(idx))), \
+        f"{rid}: duplicate or gapped token indices {idx}"
+    assert [t for _, t in got_toks[rid]] == [t for _, t in ref_toks[rid]], \
+        f"{rid}: fleet tokens diverge from single-engine reference"
+fleet = Path(sys.argv[3])
+replayed = any(
+    json.loads(line).get("name") == "journal_replayed"
+    for line in (fleet / "replica_0" / "telemetry.jsonl").read_text()
+    .splitlines() if line.strip())
+assert replayed, "dead replica's journal never replayed its owed work"
+router_end = [json.loads(line)
+              for line in (fleet / "telemetry.jsonl").read_text()
+              .splitlines()
+              if '"router_end"' in line][-1]
+assert router_end.get("redispatched", 0) >= 1, router_end
+print("[serve_smoke] OK: router round trip — 8 requests exactly once "
+      "across a mid-stream replica kill, bit-identical to the "
+      "single-engine run; journal replay recovered the owed work "
+      f"(redispatched={router_end['redispatched']})")
+PY

@@ -69,6 +69,10 @@ GOOD_PIPELINE = {"sync_batches_per_s": 300.0,
 GOOD_SERVING = {"tokens_per_s": 650.0, "ttft_p50_ms": 12.0,
                 "ttft_p99_ms": 40.0, "reject_rate": 0.0,
                 "completed": 32, "rejected": 0}
+GOOD_SCALE = {"replicas": 2, "tokens_per_s_1r": 400.0,
+              "tokens_per_s": 700.0, "scaleup": 1.75,
+              "request_share": {"0": 0.5, "1": 0.5}, "fairness": 1.0,
+              "affinity_hit_rate": 0.6, "completed": 16}
 GOOD_MEASUREMENT = {
     "tflops": 150.0, "per_iter_ms": 7.0, "amortized_ms": 7.0,
     "dispatch_overhead_ms": 60.0, "chain_lengths": [16, 48],
@@ -103,6 +107,7 @@ class TestBenchMain:
                                       "lm_tokens_per_s": 1e5}, ""),
             "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
             "--child-serving": (30, GOOD_SERVING, ""),
+            "--child-serving-scale": (40, GOOD_SCALE, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -111,6 +116,8 @@ class TestBenchMain:
         assert "extra" in out and "lm_step_ms" in out["extra"]
         assert out["input_pipeline"]["speedup"] == 1.2
         assert out["serving"]["tokens_per_s"] == 650.0
+        assert out["serving_scale"]["scaleup"] == 1.75
+        assert out["serving_scale"]["fairness"] == 1.0
 
     def test_dead_tunnel_emits_failure_with_sanity(self, bench, clock,
                                                    capsys, monkeypatch):
@@ -122,6 +129,7 @@ class TestBenchMain:
             "--child-cpu-sanity": (60, {"cpu_matmul_1024_tflops": 0.1}, ""),
             "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
             "--child-serving": (30, GOOD_SERVING, ""),
+            "--child-serving-scale": (40, GOOD_SCALE, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -135,6 +143,7 @@ class TestBenchMain:
         # continuous on dead rounds
         assert "input_pipeline" in out
         assert "serving" in out
+        assert "serving_scale" in out
         # total simulated wall time stayed inside the deadline
         assert clock.t - 1000.0 <= bench.DEADLINE_S
 
@@ -147,6 +156,7 @@ class TestBenchMain:
             "--child-cpu-sanity": (60, {"cpu_matmul_1024_tflops": 0.1}, ""),
             "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
             "--child-serving": (30, GOOD_SERVING, ""),
+            "--child-serving-scale": (40, GOOD_SCALE, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -188,6 +198,7 @@ class TestBenchMain:
             "--child-lm-step": (100, {"lm_step_ms": 30.0}, ""),
             "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
             "--child-serving": (30, GOOD_SERVING, ""),
+            "--child-serving-scale": (40, GOOD_SCALE, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -214,6 +225,7 @@ class TestBenchMain:
             "--child-cpu-sanity": (10_000, None, ""),
             "--child-input-pipeline": (10_000, None, ""),
             "--child-serving": (10_000, None, ""),
+            "--child-serving-scale": (10_000, None, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
