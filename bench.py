@@ -308,8 +308,14 @@ def _child_serving() -> None:
     params = model.init_params(jax.random.key(0), seq=8)
     engine = Engine(
         model, {"params": params},
+        # SLO targets deliberately generous (host-CPU TTFTs are tens
+        # of ms): a healthy round reports alerts_raised=0 and a
+        # regression that tanks the windowed tail RAISES — the
+        # lower-is-better key `obs diff` gates off this row
         EngineConfig(slots=4, max_len=128, eos_id=None,
-                     queue_capacity=8, prefill_budget=96),
+                     queue_capacity=8, prefill_budget=96,
+                     slo_ttft_p99_ms=10_000.0, slo_availability=0.5,
+                     slo_fast_s=5.0, slo_slow_s=20.0),
     )
     shared = 64
     spec = LoadSpec(n_requests=32, rate_hz=100.0,
@@ -367,7 +373,13 @@ def _child_serving_scale() -> None:
              "--socket", sock, "--max-len", "128", "--slots", "2",
              "--warmup-lens", f"8,{shared + 16}",
              "--queue-capacity", "16",
-             "--replica-heartbeat-every", "1"],
+             "--replica-heartbeat-every", "1",
+             # generous per-replica SLO targets (like the serving
+             # probe's): healthy rounds tally fleet_alerts_raised=0,
+             # a tail regression raises — keeps the row's
+             # alerts_raised key live instead of structurally zero
+             "--slo-ttft-p99-ms", "10000", "--slo-availability", "0.5",
+             "--slo-fast-s", "5", "--slo-slow-s", "20"],
             env=env, stderr=subprocess.DEVNULL)
         try:
             t0 = time_mod.monotonic()
@@ -416,6 +428,10 @@ def _child_serving_scale() -> None:
         "scaleup": round(tpsn / tps1, 3) if tps1 else None,
         "ttft_p50_ms": repn.get("ttft_p50_ms"),
         "ttft_p99_ms": repn.get("ttft_p99_ms"),
+        # live-plane keys: the client-side windowed tail plus the
+        # fleet alert tally the router counted off replica heartbeats
+        "ttft_p99_windowed_ms": repn.get("ttft_p99_windowed_ms"),
+        "alerts_raised": endn.get("fleet_alerts_raised", 0),
         "request_share": shares,
         "fairness": fairness,
         "affinity_hit_rate": endn.get("affinity_hit_rate"),
@@ -572,7 +588,9 @@ def _add_serving(out: dict, hb, tracer, remaining) -> None:
                  # round-over-round trace shows the tail MOVING between
                  # phases, not just growing
                  dominant_phase_p99=(srv or {}).get("dominant_phase_p99"),
-                 ttft_p99_ms=(srv or {}).get("ttft_p99_ms"))
+                 ttft_p99_ms=(srv or {}).get("ttft_p99_ms"),
+                 # SLO plane: a probe round that fired alerts says so
+                 alerts_raised=(srv or {}).get("alerts_raised"))
 
 
 def _add_serving_scale(out: dict, hb, tracer, remaining) -> None:

@@ -310,8 +310,10 @@ def main(argv=None) -> int:
         argv = argv[1:]
     if argv and argv[0] == "obs":
         # telemetry subcommands (`obs summarize <telemetry.jsonl>`,
-        # `obs doctor <run dir>`, `obs diff <a> <b>`) — pure file
-        # tools, no devices touched
+        # `obs doctor <run dir>`, `obs diff <a> <b>`, `obs trace
+        # <dir>`, `obs top <dir>` — the live fleet dashboard over the
+        # exposition sockets) — pure file/socket tools, no devices
+        # touched
         from hyperion_tpu.obs.report import main as obs_main
 
         return obs_main(argv[1:])

@@ -44,6 +44,21 @@ Consumer/health half (PR 2 — the stream diagnosing its own runs):
                   decode / preempt-replay / client-write); the doctor's
                   named serving incidents come from the same math.
 
+Live half (PR 10 — the pull-based plane for running fleets):
+  * `export`    — one-request exposition socket (`obs.sock` next to the
+                  heartbeat): a live process answers with registry
+                  counters/gauges, windowed histogram summaries, phase,
+                  drain/brownout state, and firing alerts — zero device
+                  syncs, host floats only.
+  * `slo`       — declarative SLO targets (TTFT p99, reject rate,
+                  availability) evaluated with multi-window burn rates
+                  (fast 1m / slow 10m) inside the engine/router loops;
+                  transitions emit `alert_raised`/`alert_cleared`
+                  events, ride heartbeats, and feed doctor/diff.
+  * `top`       — `obs top <dir>`: curses-free ANSI fleet dashboard
+                  polling the exposition sockets (heartbeat fallback
+                  for dead processes); `--once --json` for scripts.
+
 Reaction half (PR 3 — `train/supervisor.py` + `checkpoint/integrity.py`):
 the doctor's verdicts drive a restart supervisor (crashed/hung ->
 restart from the newest verified checkpoint; diverged -> quarantine
@@ -52,10 +67,20 @@ so `doctor` reports restart lineage, and `preempt_signal` events mark
 signal latches the instant they happen.
 """
 
+from hyperion_tpu.obs.export import (  # noqa: F401
+    MetricsExporter,
+    exposition_path,
+    read_exposition,
+)
 from hyperion_tpu.obs.health import (  # noqa: F401
     Anomaly,
     HealthConfig,
     HealthMonitor,
+)
+from hyperion_tpu.obs.slo import (  # noqa: F401
+    SLOMonitor,
+    SLOTarget,
+    standard_targets,
 )
 from hyperion_tpu.obs.heartbeat import (  # noqa: F401
     Heartbeat,

@@ -73,6 +73,10 @@ METRICS: dict[str, str] = {
     # load means lost capacity — gated like any other serving regression
     "serve_shed_rate": "lower",
     "serve_clamp_rate": "lower",
+    # SLO burn-rate alerting (obs/slo.py via the bench serving row):
+    # alerts raised under the same seeded load is a direct "the SLO
+    # got worse" signal — lower is better, zero is the healthy state
+    "serve_alerts_raised": "lower",
     # replica-tier scaling (serve/router.py via the bench serving_scale
     # row): aggregate throughput at N replicas, scaleup vs one replica,
     # dispatch fairness (min replica share x N; 1.0 = perfectly even),
@@ -154,7 +158,8 @@ def normalize(doc: dict) -> dict[str, float]:
                               ("client_write_p99_ms",
                                "serve_client_write_p99_ms"),
                               ("shed_rate", "serve_shed_rate"),
-                              ("clamp_rate", "serve_clamp_rate")):
+                              ("clamp_rate", "serve_clamp_rate"),
+                              ("alerts_raised", "serve_alerts_raised")):
                 v = _num(srv.get(src))
                 if v is not None:
                     out[name] = v

@@ -292,6 +292,9 @@ def diagnose(
                 "poisoned": c.get("serve_poisoned"),
                 "journal_errors": c.get("serve_journal_errors"),
                 "dropped_sinks": c.get("serve_dropped_sinks"),
+                # SLO burn-rate alerting (obs/slo.py)
+                "alerts_raised": c.get("serve_alerts_raised"),
+                "alerts_active": g.get("serve_alerts_active"),
             }
 
     # ---- stall signal: tail steps vs the run's own earlier median ----
@@ -451,6 +454,50 @@ def diagnose(
                                 "failed", "crashed", "hung"):
         reason += "; serving robustness: " + "; ".join(overload)
 
+    # SLO burn-rate alerts (obs/slo.py): the engine/router loops emit
+    # alert_raised/alert_cleared transitions; the doctor tallies them
+    # per alert name so a firing alert is a NAMED incident — with the
+    # metric, threshold, and the burn that tripped it — and a raised-
+    # then-cleared alert reads as a resolved incident, not noise.
+    slo_incidents: list[str] = []
+    by_alert: dict[str, dict] = {}
+    for e in events:
+        if e.get("name") not in ("alert_raised", "alert_cleared"):
+            continue
+        name = str(e.get("alert"))
+        row = by_alert.setdefault(name, {
+            "alert": name, "metric": e.get("metric"),
+            "threshold": e.get("threshold"),
+            "raised": 0, "cleared": 0, "active": False,
+            "last_value": None, "active_s": None,
+        })
+        if e.get("name") == "alert_raised":
+            row["raised"] += 1
+            row["active"] = True
+            row["last_value"] = e.get("fast")
+        else:
+            row["cleared"] += 1
+            row["active"] = False
+            row["active_s"] = e.get("active_s")
+    slo_alerts = list(by_alert.values())
+    for row in slo_alerts:
+        if row["active"]:
+            tail = ("never cleared" if not row["cleared"]
+                    else f"cleared {row['cleared']}x, re-raised")
+            slo_incidents.append(
+                f"SLO alert '{row['alert']}' FIRING "
+                f"({row['metric']} {_fmt(row['last_value'])} vs target "
+                f"{_fmt(row['threshold'])}; raised {row['raised']}x, "
+                f"{tail})")
+        else:
+            slo_incidents.append(
+                f"SLO alert '{row['alert']}' raised {row['raised']}x "
+                f"and cleared (last burn lasted "
+                f"{_fmt(row['active_s'])}s)")
+    if slo_incidents and verdict in ("healthy", "running", "stalled",
+                                     "failed", "crashed", "hung"):
+        reason += "; slo: " + "; ".join(slo_incidents)
+
     # Replica-fleet evidence (serve/router.py layout): a router run's
     # own stream can be perfectly healthy while one of its children is
     # dead — the fleet table makes each replica's state/occupancy a
@@ -553,6 +600,8 @@ def diagnose(
         ],
         "hbm_peak_mb": hbm_peak,
         "serve": serve,
+        "slo_alerts": slo_alerts,
+        "slo_incidents": slo_incidents,
         "fleet": fleet_rows,
         "fleet_incidents": fleet_incidents,
         "cache_pressure": cache_pressure,
@@ -569,6 +618,9 @@ def diagnose(
             # beat — the hung-vs-slow call needs to know whether the
             # loop froze with work in hand
             "active": hb.get("active"), "queue": hb.get("queue"),
+            # live-plane payload: the alerts list the serving loop
+            # stamps on its beats (obs/slo.py)
+            "alerts": hb.get("alerts"),
         } if hb else None,
     }
 
@@ -676,6 +728,12 @@ def render_markdown(d: dict) -> str:
                 f"{_fmt(srv.get('prefix_hit_rate'))}, preempted "
                 f"{_fmt(srv.get('preempted'))}, HBM/req "
                 f"{_fmt(srv.get('hbm_per_req_mb'))} MB{flag} |")
+    for row in d.get("slo_alerts") or []:
+        flag = " — **FIRING**" if row.get("active") else " (cleared)"
+        lines.append(
+            f"| SLO alert `{row['alert']}` | {row['metric']} vs target "
+            f"{_fmt(row['threshold'])}: raised {row['raised']}x, "
+            f"cleared {row['cleared']}x{flag} |")
     for row in d.get("fleet") or []:
         flag = (" — **dead**" if row["state"] == "dead"
                 else " — **never beat**" if row["state"] == "no heartbeat"

@@ -1,0 +1,198 @@
+"""Live metric exposition — one JSON snapshot per connection, on a
+small unix socket next to the process's heartbeat file.
+
+The heartbeat (obs/heartbeat.py) is the passive half of liveness: a
+file the process rewrites so a reader can tell hung from slow. This is
+the active half: a LIVE process answers one request with its current
+state — registry counters/gauges, windowed histogram summaries
+(`MetricsRegistry.windowed_snapshot`), heartbeat phase, drain/brownout
+flags, firing alerts — so `obs top` renders current truth for running
+fleets and falls back to heartbeat files only for the dead ones.
+
+Protocol, deliberately the dumbest thing that works: connect, read one
+JSON line, EOF. No request body, no framing, no version negotiation
+beyond the `v` field — a `nc -U <sock>` is a valid client. The payload
+is built by a caller-supplied `payload_fn` on the EXPORTER thread from
+host-side state only (python floats, bounded ring copies): answering a
+snapshot request can never add a device sync or a jit trace to the
+serving loop, which is the whole point of exposing metrics the loop
+already keeps instead of measuring anything new.
+
+Failure posture matches the heartbeat's: a socket that cannot bind, a
+payload_fn that raises, a client that disconnects mid-write — all
+degrade the observability plane, never the process it observes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket as socket_mod
+import sys
+import threading
+import time
+from pathlib import Path
+
+OBS_SCHEMA = 1
+OBS_SOCKET_NAME = "obs.sock"
+DEFAULT_WINDOW_S = 60.0
+
+
+def exposition_path(anchor: str | Path) -> Path:
+    """The canonical socket location: `obs.sock` next to the anchor
+    (a heartbeat/telemetry file) or inside it (a run directory) — the
+    path `obs top` probes for each discovered process."""
+    p = Path(anchor)
+    if p.suffix in (".json", ".jsonl"):
+        return p.parent / OBS_SOCKET_NAME
+    return p / OBS_SOCKET_NAME
+
+
+def prepare_socket_path(socket_path: str,
+                        owner: str = "live process") -> None:
+    """Make `socket_path` bindable: a socket file that survived a
+    crash (SIGKILL unlinks nothing) would fail the bind forever. Probe
+    it first — a connection REFUSED means no listener owns it (stale:
+    unlink); a successful connect means a live owner does (raise
+    loudly instead of yanking a working socket out from under it).
+    THE one implementation of this discipline: the serve transports
+    (serve/server.py) delegate here, obs is jax-free, so both layers
+    share it without serve's import chain. `owner` names the refuser
+    in the error ("live server" for transports)."""
+    if not os.path.exists(socket_path):
+        return
+    probe = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    probe.settimeout(0.25)
+    try:
+        probe.connect(socket_path)
+    except OSError:
+        try:
+            os.unlink(socket_path)
+        except OSError:
+            pass
+    else:
+        raise RuntimeError(
+            f"socket {socket_path} is owned by a {owner} — refusing "
+            "to steal it (stop the other process or pick another path)")
+    finally:
+        probe.close()
+
+
+class MetricsExporter:
+    """Background one-shot-answer server for a process's live snapshot.
+
+    `payload_fn() -> dict` supplies the body; the exporter adds the
+    envelope (schema version, kind, pid, wall time). Start failures
+    disable the exporter with a stderr note instead of killing the
+    host process — observability must never take down what it
+    observes."""
+
+    def __init__(self, socket_path: str | Path, payload_fn, *,
+                 label: str = "obs-export"):
+        self.socket_path = str(socket_path)
+        self._payload_fn = payload_fn
+        self._label = label
+        self._srv = None
+        self._thread: threading.Thread | None = None
+        self.enabled = False
+        # True only once THIS exporter has bound the path: close()
+        # must never unlink a socket some other live process owns (a
+        # refused start() would otherwise take down the rightful
+        # owner's exposition on its way out)
+        self._bound = False
+
+    def start(self) -> "MetricsExporter":
+        import socketserver
+
+        payload_fn = self._payload_fn
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    doc = payload_fn()
+                    if not isinstance(doc, dict):
+                        doc = {"error": "payload_fn returned non-dict"}
+                except Exception as e:  # noqa: BLE001 — a snapshot bug
+                    doc = {"error": repr(e)[:500]}  # must answer, not kill
+                rec = {"v": OBS_SCHEMA, "kind": "exposition",
+                       "pid": os.getpid(), "t_wall": time.time(), **doc}
+                try:
+                    self.wfile.write(
+                        json.dumps(rec, separators=(",", ":"),
+                                   default=repr).encode("utf-8") + b"\n")
+                except OSError:
+                    pass  # client vanished between connect and read
+
+        class Server(socketserver.ThreadingMixIn,
+                     socketserver.UnixStreamServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+            def handle_error(self, request, client_address):
+                pass  # a broken client is its own problem
+
+        try:
+            Path(self.socket_path).parent.mkdir(parents=True,
+                                                exist_ok=True)
+            prepare_socket_path(self.socket_path)
+            self._srv = Server(self.socket_path, Handler)
+            self._bound = True
+        except Exception as e:  # noqa: BLE001 — never kill the host loop
+            print(f"[{self._label}] exposition disabled "
+                  f"({self.socket_path}): {e}", file=sys.stderr)
+            self._srv = None
+            return self
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        name=self._label, daemon=True)
+        self._thread.start()
+        self.enabled = True
+        return self
+
+    def close(self) -> None:
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        self.enabled = False
+        if self._bound:
+            # only the binder unlinks: a refused start() must not take
+            # down the rightful owner's socket on its way out
+            self._bound = False
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+
+    def __enter__(self) -> "MetricsExporter":
+        return self if self.enabled or self._srv is not None \
+            else self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_exposition(socket_path: str | Path,
+                    timeout_s: float = 1.0) -> dict | None:
+    """One snapshot request; None when nothing (or nothing parseable)
+    answers — the caller's signal to fall back to the heartbeat file."""
+    buf = b""
+    try:
+        with socket_mod.socket(socket_mod.AF_UNIX,
+                               socket_mod.SOCK_STREAM) as s:
+            s.settimeout(timeout_s)
+            s.connect(str(socket_path))
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+    except OSError:
+        return None
+    try:
+        doc = json.loads(buf.decode("utf-8"))
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return None
+    return doc if isinstance(doc, dict) else None

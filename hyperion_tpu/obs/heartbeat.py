@@ -123,6 +123,13 @@ class Heartbeat:
         self._last_t = self._clock()
         rec = {
             "v": SCHEMA_VERSION,
+            # explicit schema stamp for the live plane's readers (obs
+            # top, the router's replica state machine): payload growth
+            # bumps nothing — new fields ride along and old readers
+            # ignore them (read_heartbeat returns the whole dict, no
+            # field whitelist) — while a future INCOMPATIBLE change
+            # bumps this and readers can branch on it
+            "schema": SCHEMA_VERSION,
             "run": self.run,
             "pid": os.getpid(),
             "proc": self.proc,
@@ -144,6 +151,14 @@ class Heartbeat:
             # a full disk must degrade the flight recorder, not the run
             self.enabled = False
 
+    @property
+    def last_phase(self) -> str | None:
+        return self._last_phase
+
+    @property
+    def last_step(self) -> int | None:
+        return self._last_step
+
     def close(self, phase: str = "done", **extra) -> None:
         """Terminal pulse — readers distinguish 'exited cleanly' from
         'stopped beating'."""
@@ -157,7 +172,10 @@ def null_heartbeat() -> Heartbeat:
 def read_heartbeat(path: str | Path) -> dict | None:
     """Parse a heartbeat file; None when missing or unreadable (an
     atomic writer means a torn file should be impossible, but a reader
-    must never crash on one anyway)."""
+    must never crash on one anyway). Unknown fields are preserved, not
+    rejected: the live plane grows the payload (alerts, occupancy,
+    replica tags) and an older reader must keep working on a newer
+    writer's file — the schema-contract tests pin this tolerance."""
     try:
         rec = json.loads(Path(path).read_text())
     except (OSError, json.JSONDecodeError, ValueError):

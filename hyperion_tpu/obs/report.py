@@ -203,11 +203,15 @@ def main(argv=None) -> int:
         from hyperion_tpu.obs.timeline import main as trace_main
 
         return trace_main(argv[1:])
+    if argv and argv[0] == "top":
+        from hyperion_tpu.obs.top import main as top_main
+
+        return top_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="hyperion obs",
         description="telemetry stream tools (obs/report.py); see also "
-                    "`obs doctor <dir>`, `obs diff <a> <b>`, and "
-                    "`obs trace <dir>`",
+                    "`obs doctor <dir>`, `obs diff <a> <b>`, "
+                    "`obs trace <dir>`, and `obs top <dir>`",
     )
     sub = p.add_subparsers(dest="cmd", required=True)
     sub.add_parser("doctor", help="classify a run (healthy/crashed/hung/"
@@ -218,6 +222,10 @@ def main(argv=None) -> int:
     sub.add_parser("trace", help="per-request waterfalls, Chrome trace "
                                  "export, and tail-latency attribution "
                                  "for a serve run")
+    sub.add_parser("top", help="live fleet dashboard over the "
+                               "exposition sockets (heartbeat fallback "
+                               "for dead processes); --once --json for "
+                               "scripting")
     s = sub.add_parser("summarize", help="render a run summary from a "
                                          "telemetry JSONL")
     s.add_argument("telemetry", help="path to telemetry.jsonl")

@@ -70,6 +70,8 @@ from hyperion_tpu.serve.blocks import (
     SeqAlloc,
     blocks_for,
 )
+from hyperion_tpu.obs import slo as slo_mod
+from hyperion_tpu.obs.export import DEFAULT_WINDOW_S
 from hyperion_tpu.serve.journal import MAX_REPLAYS_DEFAULT
 from hyperion_tpu.serve.metrics import ServeMetrics
 from hyperion_tpu.serve.queue import (
@@ -222,6 +224,12 @@ class EngineConfig:
     brownout_depth: int = 0        # enter watermark (0 = 3/4 of capacity)
     brownout_wait_s: float = 0.0   # queue-wait p95 enter watermark (0 = off)
     brownout_clamp: int = 0        # clamp max_new_tokens while active (0 = off)
+    # ---- SLO burn-rate alerting (obs/slo.py) — 0 = that target off ----
+    slo_ttft_p99_ms: float = 0.0   # windowed TTFT p99 must stay under this
+    slo_reject_rate: float = 0.0   # windowed reject fraction budget
+    slo_availability: float = 0.0  # windowed completed/(completed+failed) floor
+    slo_fast_s: float = 0.0        # fast burn window (0 = obs/slo default 60s)
+    slo_slow_s: float = 0.0        # slow burn window (0 = obs/slo default 600s)
 
 
 @dataclasses.dataclass
@@ -304,6 +312,19 @@ class Engine:
                 1, (3 * cfg.queue_capacity) // 4)
             self._governor = BrownoutGovernor(
                 depth_high=depth_high, wait_high_s=cfg.brownout_wait_s)
+        # SLO burn-rate alerting (obs/slo.py): evaluated from the
+        # serve loop (step AND idle ticks — an alert must be able to
+        # clear while the engine sits idle after load drops) over the
+        # windowed instruments the metrics layer already keeps.
+        self.slo = None
+        targets = slo_mod.standard_targets(
+            cfg.slo_ttft_p99_ms, cfg.slo_reject_rate,
+            cfg.slo_availability)
+        if targets:
+            self.slo = slo_mod.SLOMonitor(
+                targets, self.metrics.reg,
+                fast_s=cfg.slo_fast_s or slo_mod.DEFAULT_FAST_S,
+                slow_s=cfg.slo_slow_s or slo_mod.DEFAULT_SLOW_S)
         self._slots: list[Request | None] = [None] * cfg.slots
         self._seqs: list[SeqAlloc | None] = [None] * cfg.slots
         self.mgr = BlockManager(num_blocks, bs)
@@ -941,6 +962,58 @@ class Engine:
     def idle(self) -> bool:
         return self.n_active == 0 and len(self.queue) == 0
 
+    def _phase(self) -> str:
+        if self._draining:
+            return "drain"
+        return "serve" if (self.n_active or len(self.queue)) \
+            else "serve_idle"
+
+    def _slo_tick(self, now: float | None = None) -> None:
+        """Advance the SLO burn-rate state machines (rate-limited
+        inside the monitor). Transitions emit the standard
+        alert_raised/alert_cleared events AND an unconditional
+        heartbeat pulse: the heartbeat's `alerts` field is how the
+        router and `obs top` see a replica's alarm state without
+        opening its stream."""
+        if self.slo is None:
+            return
+        trs = self.slo.evaluate(now)
+        if trs:
+            slo_mod.publish(trs, self.tracer, self.metrics.reg,
+                            step=self._tick_no,
+                            active=len(self.slo.active))
+            self.hb.pulse(step=self._tick_no, phase=self._phase(),
+                          active=self.n_active, queue=len(self.queue),
+                          alerts=self.slo.active_names())
+
+    def exposition(self, window_s: float = DEFAULT_WINDOW_S) -> dict:
+        """Live snapshot for the exposition socket (obs/export.py):
+        current loop state + lifetime metrics + the last-`window_s`
+        windowed roll-up. Host floats and bounded ring copies only —
+        answering can never touch the device or trace a jit, whatever
+        thread asks."""
+        reg = self.metrics.reg
+        gov = self._governor
+        return {
+            "role": "engine",
+            "run": self.tracer.run,
+            "phase": self._phase(),
+            "tick": self._tick_no,
+            "active": self.n_active,
+            "slots": self.cfg.slots,
+            "occupancy": round(self.n_active / self.cfg.slots, 4)
+            if self.cfg.slots else 0.0,
+            "queue": len(self.queue),
+            "draining": self._draining,
+            "brownout": bool(gov.active) if gov is not None else False,
+            "blocks_in_use": self.mgr.in_use,
+            "blocks_free": self.mgr.num_free,
+            "alerts": (self.slo.active_names()
+                       if self.slo is not None else []),
+            "metrics": reg.snapshot(),
+            "windows": reg.windowed_snapshot(window_s),
+        }
+
     def step(self) -> list[TokenEvent]:
         """One scheduling round: admit from the queue into free slots
         (block-gated, prefill, budget-limited), ensure every live slot
@@ -1089,8 +1162,11 @@ class Engine:
         self.metrics.observe_cache(
             self.mgr.in_use, self.mgr.num_free, self.n_active,
             self._block_bytes)
+        self._slo_tick()
         self.hb.beat(step=self._tick_no, phase="serve",
-                     active=self.n_active, queue=len(self.queue))
+                     active=self.n_active, queue=len(self.queue),
+                     **({"alerts": self.slo.active_names()}
+                        if self.slo is not None else {}))
         return emissions
 
     def run(
@@ -1130,11 +1206,18 @@ class Engine:
                     # that raced the drain signal
                     if (self._draining or drain_when()) and self.idle:
                         break
+                    # idle SLO ticks: an alert raised under load must
+                    # be able to CLEAR while the loop sits idle after
+                    # the load drops — step() is not running, so the
+                    # idle loop owns the evaluation cadence here
+                    self._slo_tick()
                     # same payload shape as the serve beat so a watcher
                     # (obs doctor) reads occupancy whichever phase the
                     # loop froze in
                     self.hb.beat(step=self._tick_no, phase="serve_idle",
-                                 active=0, queue=len(self.queue))
+                                 active=0, queue=len(self.queue),
+                                 **({"alerts": self.slo.active_names()}
+                                    if self.slo is not None else {}))
                     time.sleep(idle_sleep_s)
                     continue
                 self.step()
@@ -1149,6 +1232,7 @@ class Engine:
                 tokens=summary["tokens"],
                 prefix_hits=summary["prefix_hits"],
                 preempted=summary["preempted"],
+                alerts_raised=summary["alerts_raised"],
             )
             # the file holds only the LAST beat, so the terminal pulse
             # repeats the occupancy payload — a watcher reading a

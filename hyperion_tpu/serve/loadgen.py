@@ -22,6 +22,7 @@ import time
 
 import numpy as np
 
+from hyperion_tpu.obs.export import DEFAULT_WINDOW_S
 from hyperion_tpu.obs.registry import percentile
 from hyperion_tpu.obs.timeline import PHASES, cohort_dominant
 from hyperion_tpu.serve.queue import Request
@@ -193,7 +194,25 @@ def run_load(engine, spec: LoadSpec) -> dict:
         if spec.n_requests else 0.0,
         **attribution,
         "dominant_phase_p99": dominant,
+        # live-plane keys (PR 10): the WINDOWED p99s `obs top` shows —
+        # over the engine's last-60s ring, which for a short probe run
+        # is the whole run — and the SLO alert counters, so a bench
+        # round that fired alerts says so on its serving row and
+        # `obs diff` can gate serve_alerts_raised lower-is-better
+        "ttft_p99_windowed_ms": _win_p99(engine, "ttft_ms"),
+        "tpot_p99_windowed_ms": _win_p99(engine, "tpot_ms"),
+        "alerts_raised": cache.get("alerts_raised", 0),
+        "alerts_active": cache.get("alerts_active", 0),
     }
+
+
+def _win_p99(engine, hist: str,
+             window_s: float = DEFAULT_WINDOW_S) -> float | None:
+    """Windowed p99 of one engine histogram (obs/registry.py ring) —
+    None when the window saw nothing."""
+    w = engine.metrics.reg.histogram(hist).windowed(window_s)
+    p = w.get("p99")
+    return round(p, 3) if isinstance(p, (int, float)) else None
 
 
 def run_load_socket(socket_path: str, spec: LoadSpec, *,
@@ -270,6 +289,14 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
                for r in done if "first_token_at" in r]
     e2e_ms = [(r["finished_at"] - r["submitted_at"]) * 1e3
               for r in done if "finished_at" in r]
+    # client-side windowed p99: requests whose first token landed in
+    # the run's last exposition window — the socket driver cannot read
+    # engine rings, so it computes the same "recent" view from its own
+    # clocks
+    cut = time.monotonic() - DEFAULT_WINDOW_S
+    ttft_win = [(r["first_token_at"] - r["submitted_at"]) * 1e3
+                for r in done
+                if "first_token_at" in r and r["first_token_at"] >= cut]
     tokens = sum(r.get("tokens", 0) for r in done)
     rejected = sum(1 for r in results
                    if r.get("status") in ("rejected", "error"))
@@ -288,6 +315,8 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
         "ttft_p99_ms": round(percentile(ttft_ms, 99), 3) if ttft_ms else None,
         "e2e_p50_ms": round(percentile(e2e_ms, 50), 3) if e2e_ms else None,
         "e2e_p99_ms": round(percentile(e2e_ms, 99), 3) if e2e_ms else None,
+        "ttft_p99_windowed_ms": round(percentile(ttft_win, 99), 3)
+        if ttft_win else None,
         "elapsed_s": round(elapsed, 3),
         "arrival_rate_hz": spec.rate_hz,
         "shared_prefix_tokens": spec.shared_prefix_tokens,

@@ -49,10 +49,14 @@ class ServeMetrics:
                      # crash-safety + overload (journal/drain/brownout)
                      "serve_shed", "serve_brownout_clamped",
                      "serve_replayed", "serve_poisoned",
-                     "serve_journal_errors", "serve_dropped_sinks"):
+                     "serve_journal_errors", "serve_dropped_sinks",
+                     # SLO burn-rate alerting (obs/slo.py): a run that
+                     # never alerted must snapshot raised=0, not omit it
+                     "serve_alerts_raised", "serve_alerts_cleared"):
             self.reg.counter(name)
         # 0/1 flag, pre-set so "never browned out" snapshots as 0
         self.reg.gauge("serve_brownout_active").set(0.0)
+        self.reg.gauge("serve_alerts_active").set(0.0)
 
     # -------------------------------------------------- admission edge
 
@@ -243,6 +247,10 @@ class ServeMetrics:
             "poisoned": int(c.get("serve_poisoned", 0)),
             "journal_errors": int(c.get("serve_journal_errors", 0)),
             "dropped_sinks": int(c.get("serve_dropped_sinks", 0)),
+            # SLO burn-rate alerting (obs/slo.py)
+            "alerts_raised": int(c.get("serve_alerts_raised", 0)),
+            "alerts_cleared": int(c.get("serve_alerts_cleared", 0)),
+            "alerts_active": int(g.get("serve_alerts_active") or 0),
         }
 
 
@@ -264,10 +272,18 @@ class RouterMetrics:
         for name in ("route_dispatched", "route_redispatched",
                      "route_rejected", "route_completed",
                      "route_affinity_lookups", "route_affinity_hits",
-                     "replica_ejections", "replica_readmits"):
+                     "replica_ejections", "replica_readmits",
+                     # SLO alerting: the router's OWN burn-rate alerts
+                     # (obs/slo.py publishes with prefix="route") plus
+                     # the fleet tally of alerts its replicas report on
+                     # their heartbeats — both pre-created so 0 renders
+                     "route_alerts_raised", "route_alerts_cleared",
+                     "fleet_alerts_raised"):
             self.reg.counter(name)
         self.reg.gauge("fleet_ready").set(0.0)
         self.reg.gauge("fleet_inflight").set(0.0)
+        self.reg.gauge("fleet_alerts_active").set(0.0)
+        self.reg.gauge("route_alerts_active").set(0.0)
 
     def on_dispatch(self, replica: int, affinity_hit: bool,
                     had_key: bool) -> None:
@@ -305,10 +321,22 @@ class RouterMetrics:
         with self._lock:
             self.reg.counter("replica_readmits").inc()
 
-    def observe_fleet(self, ready: int, inflight: int) -> None:
+    def observe_fleet(self, ready: int, inflight: int,
+                      alerts_active: int | None = None) -> None:
         with self._lock:
             self.reg.gauge("fleet_ready").set(ready)
             self.reg.gauge("fleet_inflight").set(inflight)
+            if alerts_active is not None:
+                self.reg.gauge("fleet_alerts_active").set(alerts_active)
+
+    def on_fleet_alerts(self, n_new: int) -> None:
+        """`n_new` alert names appeared on replica heartbeats since the
+        last monitor sweep (serve/router.py counts the transitions —
+        this is the fleet-wide raise tally bench's serving_scale row
+        reads back from router_end)."""
+        if n_new:
+            with self._lock:
+                self.reg.counter("fleet_alerts_raised").inc(n_new)
 
     def summary(self) -> dict:
         with self._lock:
@@ -330,4 +358,9 @@ class RouterMetrics:
             "ejections": int(c.get("replica_ejections", 0)),
             "readmits": int(c.get("replica_readmits", 0)),
             "per_replica_dispatched": share,
+            # SLO alerting: router-local raises + the fleet tally of
+            # replica-reported alerts (both ride router_end)
+            "alerts_raised": int(c.get("route_alerts_raised", 0)),
+            "fleet_alerts_raised": int(c.get("fleet_alerts_raised", 0)),
+            "fleet_alerts_active": int(g.get("fleet_alerts_active") or 0),
         }

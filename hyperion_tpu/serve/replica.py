@@ -61,6 +61,10 @@ class ReplicaHandle:
     hb_active: int = 0
     hb_queue: int = 0
     hb_pid: int | None = None
+    # SLO alerts the replica reported on its last beat (obs/slo.py via
+    # the engine's `alerts` heartbeat field) — the router's monitor
+    # tallies these fleet-wide and `obs top` shows them per row
+    hb_alerts: tuple = ()
 
     # --- router-side accounting ---
     # dispatches newer than the last beat: the beat's active/queue
@@ -123,6 +127,9 @@ class ReplicaHandle:
         self.hb_queue = int(hb.get("queue") or 0)
         self.hb_pid = hb.get("pid") if isinstance(hb.get("pid"), int) \
             else self.hb_pid
+        alerts = hb.get("alerts")
+        self.hb_alerts = (tuple(str(a) for a in alerts)
+                          if isinstance(alerts, (list, tuple)) else ())
         self.dispatched_since_beat = 0
         if self.state in (STARTING, EJECTED) \
                 and self.hb_phase in SERVE_PHASES \

@@ -64,6 +64,8 @@ import time
 from collections import OrderedDict
 from pathlib import Path
 
+from hyperion_tpu.obs import slo as slo_mod
+from hyperion_tpu.obs.export import DEFAULT_WINDOW_S
 from hyperion_tpu.serve.client import TERMINAL_EVENTS, ServeClient
 from hyperion_tpu.serve.metrics import RouterMetrics
 from hyperion_tpu.serve.queue import (
@@ -279,6 +281,16 @@ def replica_argv(args, rep: ReplicaHandle) -> list[str]:
             "--drain-timeout", str(args.drain_timeout)]
     argv.append("--prefix-cache" if args.prefix_cache
                 else "--no-prefix-cache")
+    # engine-level SLO targets ride to every replica (the TTFT
+    # histograms live in the engines; the router only tallies the
+    # alerts their heartbeats report back)
+    for flag, val in (("--slo-ttft-p99-ms", args.slo_ttft_p99_ms),
+                      ("--slo-reject-rate", args.slo_reject_rate),
+                      ("--slo-availability", args.slo_availability),
+                      ("--slo-fast-s", args.slo_fast_s),
+                      ("--slo-slow-s", args.slo_slow_s)):
+        if val:
+            argv += [flag, str(val)]
     if args.no_tokenizer:
         argv.append("--no-tokenizer")
     else:
@@ -290,6 +302,19 @@ def replica_argv(args, rep: ReplicaHandle) -> list[str]:
     if plan:
         argv += ["--chaos", plan]
     return argv
+
+
+def _route_window_value(reg, metric: str, window_s: float,
+                        now: float | None = None,
+                        min_count: int = 1) -> float | None:
+    """Router-level SLO metric: the fraction of finished relays the
+    ROUTER rejected (fleet saturation / no-replica), windowed. Engine
+    rejects a replica absorbed via re-dispatch never count — those are
+    the router doing its job."""
+    if metric == "reject_rate":
+        return slo_mod.counter_ratio(reg, ("route_rejected",),
+                                     ("route_completed",), window_s, now)
+    return None
 
 
 class Router:
@@ -323,6 +348,23 @@ class Router:
         self._hard_stop = threading.Event()  # abandon in-flight relays
         self._mon_stop = threading.Event()
         self._mon_thread: threading.Thread | None = None
+        # live plane: alert names already seen per replica (so the
+        # fleet tally counts RAISES, not beats), the router's own SLO
+        # monitor (route-level reject rate), and the exposition socket
+        self._fleet_alert_seen: dict[int, set] = {}
+        self._exporter = None
+        self._slo = None
+        route_budget = getattr(args, "slo_reject_rate", 0.0) or 0.0
+        if route_budget > 0:
+            self._slo = slo_mod.SLOMonitor(
+                (slo_mod.SLOTarget("route_reject_rate", "reject_rate",
+                                   float(route_budget)),),
+                self.metrics.reg,
+                fast_s=getattr(args, "slo_fast_s", 0.0)
+                or slo_mod.DEFAULT_FAST_S,
+                slow_s=getattr(args, "slo_slow_s", 0.0)
+                or slo_mod.DEFAULT_SLOW_S,
+                value_fn=_route_window_value)
 
     # ----------------------------------------------------------- fleet
 
@@ -398,6 +440,66 @@ class Router:
                   f"stopping={self._stopping.is_set()})")
         self._eject(rep, f"supervisor finished (rc {rc})")
 
+    def exposition(self, window_s: float = DEFAULT_WINDOW_S) -> dict:
+        """Live snapshot for the router's exposition socket: fleet
+        table (per-replica state/occupancy/alerts from the handles the
+        monitor keeps fresh) + the router's own metrics. Host-only —
+        the router never touches a jax backend, and neither does this."""
+        reps = [{
+            "replica": r.index, "state": r.state, "phase": r.hb_phase,
+            "active": r.hb_active, "queue": r.hb_queue,
+            "inflight": r.inflight, "restarts": r.restarts,
+            "alerts": list(r.hb_alerts),
+        } for r in self.replicas]
+        own = (self._slo.active_names() if self._slo is not None else [])
+        # the aggregated list counts READY replicas only (a dead
+        # child's stale alarm is not a live alert); the per-replica
+        # rows keep the last-known alerts next to their state, so the
+        # evidence is still on the board
+        fleet = [f"r{r['replica']}:{a}" for r in reps
+                 if r["state"] == READY for a in r["alerts"]]
+        return {
+            "role": "router",
+            "run": self.tracer.run,
+            "phase": "route",
+            "step": self.metrics.summary()["dispatched"],
+            "active": self.policy.inflight_total,
+            "queue": 0,
+            "ready": self.policy.ready_count,
+            "draining": self._stopping.is_set(),
+            "alerts": own + fleet,
+            "replicas": reps,
+            "metrics": self.metrics.reg.snapshot(),
+            "windows": self.metrics.reg.windowed_snapshot(window_s),
+        }
+
+    def _sweep_fleet_alerts(self) -> list[str]:
+        """Fleet alert surfacing: each replica's heartbeat carries the
+        SLO alerts its engine has FIRING (obs/slo.py); the router
+        tallies them so one `obs top` row — and one router_end field —
+        answers "is anything alarming, anywhere" without opening N
+        streams. New names count as raises; a name persisting across
+        beats does not re-count. Only a DISPATCHABLE replica's alerts
+        count: an ejected/dead child's last beat would otherwise keep
+        a ghost alert firing fleet-wide forever (the dead replica
+        itself is already a named incident — its stale alarm must not
+        page on top of it). A restarted replica still alerting
+        re-counts on readmission: a new observation epoch, honestly
+        re-raised."""
+        fleet_alerts: list[str] = []
+        new_raises = 0
+        for rep in self.replicas:
+            cur = set(rep.hb_alerts) if rep.state == READY else set()
+            fleet_alerts += [f"r{rep.index}:{a}" for a in sorted(cur)]
+            fresh = cur - self._fleet_alert_seen.get(rep.index, set())
+            for a in sorted(fresh):
+                new_raises += 1
+                self.tracer.event("replica_alert", replica=rep.index,
+                                  alert=a)
+            self._fleet_alert_seen[rep.index] = cur
+        self.metrics.on_fleet_alerts(new_raises)
+        return fleet_alerts
+
     def start(self) -> None:
         self.tracer.event(
             "router_start", replicas=len(self.replicas),
@@ -405,6 +507,18 @@ class Router:
             stale_s=self.args.stale_s,
             affinity_prefix=self.args.affinity_prefix)
         self.hb.pulse(phase="route_spawn", ready=0)
+        if self.hb.enabled:
+            # obs.sock next to the router's heartbeat — `obs top` on
+            # the base dir reads the whole fleet through this one
+            # socket even before it walks the replica dirs
+            from hyperion_tpu.obs.export import (
+                MetricsExporter,
+                exposition_path,
+            )
+
+            self._exporter = MetricsExporter(
+                exposition_path(self.hb.path), self.exposition,
+                label="route-obs").start()
         for rep in self.replicas:
             rep.dir.mkdir(parents=True, exist_ok=True)
             t = threading.Thread(target=self._supervise_one, args=(rep,),
@@ -439,10 +553,18 @@ class Router:
                     self._notify_eject(tr[1], tr[2])
             ready = self.policy.ready_count
             inflight = self.policy.inflight_total
-            self.metrics.observe_fleet(ready, inflight)
+            fleet_alerts = self._sweep_fleet_alerts()
+            self.metrics.observe_fleet(ready, inflight,
+                                       alerts_active=len(fleet_alerts))
+            if self._slo is not None:
+                trs = self._slo.evaluate()
+                if trs:
+                    slo_mod.publish(trs, self.tracer, self.metrics.reg,
+                                    prefix="route",
+                                    active=len(self._slo.active))
             self.hb.beat(step=self.metrics.summary()["dispatched"],
                          phase="route", active=inflight, queue=0,
-                         ready=ready)
+                         ready=ready, alerts=fleet_alerts)
             now = time.monotonic()
             if now - last_snap >= 5.0:
                 self.tracer.snapshot(self.metrics.reg)
@@ -682,6 +804,8 @@ class Router:
         self._mon_stop.set()
         if self._mon_thread is not None:
             self._mon_thread.join(timeout=5.0)
+        if self._exporter is not None:
+            self._exporter.close()
         summary = self.metrics.summary()
         summary["per_replica_restarts"] = {
             str(r.index): r.restarts for r in self.replicas}
@@ -874,6 +998,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--replica-heartbeat-every", type=int, default=5,
                    help="replica beat cadence in ticks — the router's "
                         "load scores are only as fresh as these beats")
+    # ---- SLO burn-rate alerting (obs/slo.py) ----
+    p.add_argument("--slo-ttft-p99-ms", type=float, default=0.0,
+                   help="per-replica SLO target forwarded to every "
+                        "engine (windowed TTFT p99 ceiling in ms; 0 = "
+                        "off); firing alerts ride replica heartbeats "
+                        "back into the router's fleet tally")
+    p.add_argument("--slo-reject-rate", type=float, default=0.0,
+                   help="reject-rate budget (0 = off): forwarded to "
+                        "every engine AND evaluated router-level over "
+                        "the fleet-wide relay outcomes (prefix "
+                        "`route_` on the router's own alerts)")
+    p.add_argument("--slo-availability", type=float, default=0.0,
+                   help="per-replica availability floor forwarded to "
+                        "every engine (0 = off)")
+    p.add_argument("--slo-fast-s", type=float, default=0.0,
+                   help="fast burn window seconds (0 = 60)")
+    p.add_argument("--slo-slow-s", type=float, default=0.0,
+                   help="slow burn window seconds (0 = 600)")
     return p
 
 

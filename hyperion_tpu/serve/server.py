@@ -209,27 +209,15 @@ def prepare_socket_path(socket_path: str) -> None:
     exact restart loop the serve supervisor runs. Probe it first: a
     connection REFUSED means no listener owns it (stale — unlink); a
     successful connect means a live server does (refuse loudly instead
-    of yanking a working deployment's socket out from under it)."""
-    import os
-    import socket as socket_mod
+    of yanking a working deployment's socket out from under it). The
+    probe discipline itself lives in obs/export.py (jax-free, shared
+    with the exposition sockets) — this is the serve-transport entry
+    point."""
+    from hyperion_tpu.obs.export import (
+        prepare_socket_path as _prepare,
+    )
 
-    if not os.path.exists(socket_path):
-        return
-    probe = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
-    probe.settimeout(0.25)
-    try:
-        probe.connect(socket_path)
-    except OSError:
-        try:
-            os.unlink(socket_path)
-        except OSError:
-            pass
-    else:
-        raise RuntimeError(
-            f"socket {socket_path} is owned by a live server — refusing "
-            "to steal it (stop the other process or pick another path)")
-    finally:
-        probe.close()
+    _prepare(socket_path, owner="live server")
 
 
 def serve_socket(engine, socket_path: str, tok=None,
@@ -436,6 +424,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="while browned out, clamp each new admission's "
                         "max_new_tokens to this (0 = shed only); "
                         "recorded on the journal so replays honor it")
+    # ---- SLO burn-rate alerting (obs/slo.py) ----
+    p.add_argument("--slo-ttft-p99-ms", type=float, default=0.0,
+                   help="SLO target: windowed TTFT p99 must stay under "
+                        "this many ms (0 = target off). Breaching it in "
+                        "BOTH burn windows raises an `alert_raised` "
+                        "event + an `alerts` heartbeat field; clearing "
+                        "needs both windows back under 90%% of target")
+    p.add_argument("--slo-reject-rate", type=float, default=0.0,
+                   help="SLO target: windowed rejected/(accepted+"
+                        "rejected) budget (e.g. 0.05; 0 = off)")
+    p.add_argument("--slo-availability", type=float, default=0.0,
+                   help="SLO target: windowed completed/(completed+"
+                        "rejected+timed_out) floor (e.g. 0.99; 0 = off)")
+    p.add_argument("--slo-fast-s", type=float, default=0.0,
+                   help="fast burn window in seconds (0 = 60): 'is it "
+                        "bad right now'")
+    p.add_argument("--slo-slow-s", type=float, default=0.0,
+                   help="slow burn window in seconds (0 = 600): 'has "
+                        "it been bad long enough to matter' — also the "
+                        "alert's clearing memory")
     return p
 
 
@@ -607,12 +615,32 @@ def main(argv=None) -> int:
             brownout_depth=args.brownout_depth,
             brownout_wait_s=args.brownout_wait_s,
             brownout_clamp=args.brownout_clamp,
+            slo_ttft_p99_ms=args.slo_ttft_p99_ms,
+            slo_reject_rate=args.slo_reject_rate,
+            slo_availability=args.slo_availability,
+            slo_fast_s=args.slo_fast_s,
+            slo_slow_s=args.slo_slow_s,
         ),
         tracer=tracer, heartbeat=hb, chaos=chaos, journal=journal,
     )
     hb.pulse(phase="warmup")
     warm = [int(x) for x in args.warmup_lens.split(",") if x.strip()]
     engine.warmup(warm or None)
+
+    # live exposition socket (obs/export.py): obs.sock next to the
+    # heartbeat file, answering one JSON snapshot per connection off
+    # the metrics the engine already keeps — `obs top` polls it. Rides
+    # the heartbeat's enablement: no telemetry, no live plane.
+    exporter = None
+    if hb.enabled:
+        from hyperion_tpu.obs.export import (
+            MetricsExporter,
+            exposition_path,
+        )
+
+        exporter = MetricsExporter(exposition_path(hb.path),
+                                   engine.exposition,
+                                   label="serve-obs").start()
 
     # graceful drain: first SIGTERM/SIGINT closes the queue and lets
     # in-flight work finish under --drain-timeout; a second one stops
@@ -653,6 +681,8 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if exporter is not None:
+            exporter.close()
         if journal is not None:
             if engine.idle:
                 # fully drained: mark the WAL clean so the next start

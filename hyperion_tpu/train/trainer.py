@@ -266,6 +266,30 @@ def _epoch_loop(
     hb = obs_heartbeat.Heartbeat.for_tracer(
         tracer, every=cfg.train.heartbeat_every or 25,
         static={"attempt": attempt})
+    # live exposition socket (obs/export.py): obs.sock next to the
+    # heartbeat, answering one registry snapshot (+ windowed roll-up)
+    # per connection so `obs top` reads a RUNNING trainer's throughput
+    # and phase without waiting for the post-hoc stream. Host floats
+    # only — the payload is built from the same registry the loop
+    # already writes, so answering cannot add a device sync.
+    import contextlib
+
+    exporter: Any = contextlib.nullcontext()
+    if hb.enabled:
+        from hyperion_tpu.obs.export import (
+            DEFAULT_WINDOW_S,
+            MetricsExporter,
+            exposition_path,
+        )
+
+        def _live_payload() -> dict:
+            return {"role": "trainer", "job": job, "run": tracer.run,
+                    "phase": hb.last_phase, "step": hb.last_step,
+                    "metrics": reg.snapshot(),
+                    "windows": reg.windowed_snapshot(DEFAULT_WINDOW_S)}
+
+        exporter = MetricsExporter(exposition_path(hb.path),
+                                   _live_payload, label="train-obs")
     # deterministic fault injection (testing/chaos.py): activated by
     # _prepare_run when a plan is configured, None otherwise — the hooks
     # below are single attribute checks when chaos is off
@@ -343,7 +367,10 @@ def _epoch_loop(
         flags = multihost_utils.process_allgather(np.int32(guard.triggered))
         return bool(np.asarray(flags).max())
 
-    with guard:
+    # the exporter rides the guard's with-block: every exit path —
+    # normal drain, preemption return, abort return, exception —
+    # closes the socket and unlinks obs.sock
+    with guard, exporter:
         for epoch in range(resume_epoch, cfg.train.epochs):
             # mid-epoch resume after a preemption: only the interrupted
             # epoch skips its already-trained prefix

@@ -285,3 +285,98 @@ print("[serve_smoke] OK: router round trip — 8 requests exactly once "
       "single-engine run; journal replay recovered the owed work "
       f"(redispatched={router_end['redispatched']})")
 PY
+
+# 8. live observability probe: a RESIDENT 2-replica fleet behind the
+#    router's socket front-end; concurrent traffic warms both replicas'
+#    windowed rings, then `obs top --once --json` must render the
+#    router row plus both replica rows LIVE — state/occupancy/windowed
+#    TTFT p99 sourced from the exposition sockets (obs/export.py), not
+#    from post-hoc files — before a SIGTERM drains the fleet.
+python -m hyperion_tpu.cli.main route \
+    --replicas 2 --min-ready 2 --ckpt "$WORK/llama.npz" --no-tokenizer \
+    --base-dir "$WORK/fleet_live" --max-len 64 --slots 2 \
+    --warmup-lens 8 --replica-heartbeat-every 1 \
+    --socket "$WORK/route_live.sock" --slo-ttft-p99-ms 60000 \
+    2> "$WORK/route_live.log" &
+ROUTE_PID=$!
+# under `set -e`, a failed assertion below would otherwise leak the
+# backgrounded fleet (supervisors keep restarting children) — always
+# drain it on the way out, however this script exits
+trap 'kill -TERM "$ROUTE_PID" 2>/dev/null || true' EXIT
+
+python - "$WORK" <<'PY'
+import sys
+import threading
+import time
+from pathlib import Path
+
+from hyperion_tpu.obs.top import sample_all
+from hyperion_tpu.serve.client import ServeClient
+
+work = Path(sys.argv[1])
+sock = work / "route_live.sock"
+t0 = time.monotonic()
+while not sock.exists():
+    assert time.monotonic() - t0 < 240, "router socket never appeared"
+    time.sleep(0.2)
+
+# concurrent requests so least-loaded dispatch spreads over BOTH
+# replicas and each engine's windowed TTFT ring has samples; worker
+# failures are COLLECTED — an assertion inside a thread would
+# otherwise print and vanish while the script sails on to OK
+errors = []
+
+def drive(i):
+    try:
+        with ServeClient(str(sock)) as c:
+            res = c.generate(id=f"live{i}", prompt_ids=[3 + i, 4, 5, 6],
+                             max_new_tokens=3)
+            assert res["final"]["event"] == "done", res
+    except Exception as e:  # noqa: BLE001 — surfaced below
+        errors.append(f"live{i}: {e!r}")
+
+threads = [threading.Thread(target=drive, args=(i,)) for i in range(4)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=120)
+assert not errors, f"warm-up requests failed: {errors}"
+assert not any(t.is_alive() for t in threads), "a warm-up request hung"
+
+# settle until both replicas answer their sockets with warm TTFT
+# rings — the CLI probe below is the single asserted frame
+deadline = time.monotonic() + 60
+while True:
+    rows = sample_all(work / "fleet_live")
+    live = [r for r in rows if r["name"].startswith("replica")
+            and r["state"] == "live" and r["ttft_p99_ms"] is not None]
+    if len(live) == 2:
+        break
+    assert time.monotonic() < deadline, f"fleet never fully live: {rows}"
+    time.sleep(0.5)
+PY
+
+python -m hyperion_tpu.cli.main obs top "$WORK/fleet_live" \
+    --once --json > "$WORK/top.json"
+
+python - "$WORK/top.json" <<'PY'
+import json
+import sys
+
+doc = json.loads(open(sys.argv[1]).read())
+rows = {r["name"]: r for r in doc["rows"]}
+live = [r for n, r in rows.items()
+        if n.startswith("replica") and r["state"] == "live"]
+assert len(live) == 2, f"expected both replica rows live: {rows}"
+assert rows["router"]["source"] == "socket", rows["router"]
+for r in live:
+    assert r["source"] == "socket" and r["occupancy"] is not None, r
+    assert r["ttft_p99_ms"] is not None, r
+print("[serve_smoke] OK: obs top — router + 2 replica rows live off "
+      "the exposition sockets (windowed ttft p99s "
+      f"{[r['ttft_p99_ms'] for r in live]} ms)")
+PY
+
+kill -TERM "$ROUTE_PID" 2>/dev/null || true
+wait "$ROUTE_PID" || true
+trap - EXIT

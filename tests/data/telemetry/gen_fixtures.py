@@ -24,6 +24,13 @@ test asserts the record fields stay stable):
                 one timeout with `queued_s` — the golden stream
                 `obs trace` reconstructs and `obs doctor` must raise a
                 named queue-wait incident on (tests/test_timeline.py)
+    slo/      — an overload serve run driven through the REAL burn-rate
+                monitor (obs/slo.py, fake clocks): windowed TTFT p99
+                breaches its target in both windows → exactly ONE
+                `alert_raised`; load then drops and the windows drain →
+                exactly ONE `alert_cleared`. `obs doctor` must name the
+                resolved alert; the schema test pins the event payloads
+                (tests/test_obs_live.py)
 
 Everything is driven by fake clocks pinned to _WALL0 so the files are
 byte-stable across regenerations (no real time leaks in). The committed
@@ -260,13 +267,85 @@ def serve():
     t.close()
 
 
+def slo():
+    """Overload run for the live plane: the REAL SLOMonitor (fake
+    clock, test-scaled windows — fast 2s / slow 8s) watches a windowed
+    TTFT p99 target of 100 ms while the run observes 400 ms TTFTs.
+    Both windows breach → one `alert_raised`; load stops, the rings
+    drain → one `alert_cleared`. The hysteresis (clear at 90% of
+    target in BOTH windows) is exercised by the same math production
+    runs — the fixture just pins its wire records."""
+    from hyperion_tpu.obs import slo as slo_mod
+
+    d, t, hb, clk, wall = _setup("slo", "fix_slo")
+
+    def adv(s: float) -> None:
+        clk.advance(s)
+        wall.advance(s)
+
+    reg = MetricsRegistry(clock=clk)
+    # min_count scaled down with the windows: the 2s fast window at
+    # one request/s holds 2 samples — the production floor (5) is for
+    # production windows
+    mon = slo_mod.SLOMonitor(
+        slo_mod.standard_targets(ttft_p99_ms=100.0, min_count=2), reg,
+        fast_s=2.0, slow_s=8.0, eval_every_s=0.5, clock=clk)
+    t.event("serve_start", slots=2, max_len=64, block_size=8,
+            num_blocks=17, prefix_cache=True)
+    hb.pulse(phase="serve", step=0, active=2, queue=3, alerts=[])
+    raised = cleared = 0
+
+    def pump(step: int, phase: str, active: int, queue: int) -> None:
+        nonlocal raised, cleared
+        for tr in mon.evaluate():
+            slo_mod.publish([tr], t, reg, step=step,
+                            active=len(mon.active))
+            raised += tr["kind"] == "raised"
+            cleared += tr["kind"] == "cleared"
+            hb.pulse(step=step, phase=phase, active=active, queue=queue,
+                     alerts=mon.active_names())
+
+    # overload: ten 400 ms TTFTs, one per second — 4x the target's
+    # budget in both windows almost immediately
+    for i in range(10):
+        with t.span("serve_tick", step=i) as sp:
+            adv(0.010)
+            sp.set(active=2)
+        reg.counter("serve_ticks").inc()
+        reg.counter("serve_accepted").inc()
+        reg.counter("serve_completed").inc()
+        reg.histogram("ttft_ms").observe(400.0)
+        reg.gauge("queue_depth").set(3.0)
+        reg.gauge("slot_occupancy").set(1.0)
+        reg.gauge("tokens_per_s").set(8.0)
+        adv(0.990)
+        pump(i, "serve", 2, 3)
+        hb.beat(step=i, phase="serve", active=2, queue=3,
+                alerts=mon.active_names())
+    # load drops: the loop idles, the windows drain, the alert clears
+    # once BOTH windows are back under the clear ratio
+    for i in range(10, 24):
+        adv(1.0)
+        pump(i, "serve_idle", 0, 0)
+        hb.beat(step=i, phase="serve_idle", active=0, queue=0,
+                alerts=mon.active_names())
+    assert raised == 1 and cleared == 1, (raised, cleared)
+    assert not mon.active
+    t.snapshot(reg, step=24)
+    t.event("serve_end", ticks=24, completed=10, rejected=0,
+            timed_out=0, tokens=40, prefix_hits=0, preempted=0,
+            alerts_raised=1)
+    hb.close(phase="done", active=0, queue=0, alerts=[])
+    t.close()
+
+
 def main() -> int:
     from unittest import mock
 
     # Heartbeat stamps os.getpid() into heartbeat.json; pin it so
     # regeneration really is byte-stable (the clocks already are)
     with mock.patch("os.getpid", return_value=4242):
-        for fn in (healthy, nan, stalled, hung, crashed, serve):
+        for fn in (healthy, nan, stalled, hung, crashed, serve, slo):
             fn()
             print(f"wrote {fn.__name__}/")
     return 0

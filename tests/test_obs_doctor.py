@@ -23,7 +23,8 @@ from hyperion_tpu.obs.trace import Tracer
 FIXTURES = Path(__file__).parent / "data" / "telemetry"
 REPO = Path(__file__).resolve().parents[1]
 
-ALL_FIXTURES = ("healthy", "nan", "stalled", "hung", "crashed", "serve")
+ALL_FIXTURES = ("healthy", "nan", "stalled", "hung", "crashed", "serve",
+                "slo")
 
 
 class FakeClock:
@@ -300,11 +301,31 @@ class TestRecordContract:
     def test_heartbeat_contract(self, name):
         hb = read_heartbeat(FIXTURES / name / "heartbeat.json")
         assert hb is not None
-        for field, typ in (("v", int), ("run", str), ("pid", int),
+        for field, typ in (("v", int), ("schema", int), ("run", str),
+                           ("pid", int),
                            ("proc", int), ("step", int), ("phase", str),
                            ("t_wall", (int, float)),
                            ("t_mono", (int, float)), ("beats", int)):
             assert isinstance(hb[field], typ), (name, field)
+
+    @pytest.mark.parametrize("name", ALL_FIXTURES)
+    def test_heartbeat_reader_tolerates_unknown_fields(self, name, tmp_path):
+        """Live-plane payload growth (alerts, occupancy, whatever comes
+        next) must never break an older reader: read_heartbeat returns
+        the whole dict, no field whitelist, and the age helper keeps
+        working with strangers in the record."""
+        import time as _time
+
+        from hyperion_tpu.obs.heartbeat import heartbeat_age_s
+
+        hb = read_heartbeat(FIXTURES / name / "heartbeat.json")
+        grown = {**hb, "alerts": ["ttft_p99"], "from_the_future": {"x": 1}}
+        p = tmp_path / "heartbeat.json"
+        p.write_text(json.dumps(grown))
+        back = read_heartbeat(p)
+        assert back["from_the_future"] == {"x": 1}
+        assert back["phase"] == hb["phase"]
+        assert heartbeat_age_s(back, now=_time.time()) is not None
 
     @pytest.mark.parametrize("name", ALL_FIXTURES)
     def test_summarize_reads_every_fixture(self, name):

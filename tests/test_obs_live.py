@@ -1,0 +1,613 @@
+"""Live observability plane: windowed registry math, exposition socket
+round trips, SLO burn-rate hysteresis, and the `obs top` dashboard.
+
+Everything here is host-only — fake clocks, unix sockets, JSONL files;
+no jax import, zero jit compiles. The engine-integration half (a live
+engine's exposition payload, the seeded overload drill that raises and
+clears a real alert) lives in tests/test_serve.py on the suite's
+already-compiled shapes.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from hyperion_tpu.obs import slo as slo_mod
+from hyperion_tpu.obs import top as top_mod
+from hyperion_tpu.obs.export import (
+    MetricsExporter,
+    exposition_path,
+    read_exposition,
+)
+from hyperion_tpu.obs.registry import MetricsRegistry, percentile
+from hyperion_tpu.obs.trace import Tracer
+
+FIXTURES = Path(__file__).parent / "data" / "telemetry"
+REPO = Path(__file__).resolve().parents[1]
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+# ------------------------------------------------------ windowed math
+
+
+class TestWindowedInstruments:
+    def test_histogram_window_matches_offline_percentile(self):
+        """The windowed p99 over a window covering EVERYTHING must
+        equal the offline nearest-rank percentile the timeline tools
+        compute — one percentile definition, live and post-hoc."""
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        h = reg.histogram("ttft_ms")
+        vals = [float(7 * i % 53) for i in range(40)]
+        for v in vals:
+            h.observe(v)
+            clk.advance(0.1)
+        w = h.windowed(1000.0)
+        assert w["count"] == 40
+        for p in (50, 95, 99):
+            assert w[f"p{p}"] == percentile(vals, p)
+        assert w["mean"] == pytest.approx(sum(vals) / len(vals))
+
+    def test_histogram_window_drops_old_observations(self):
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        h = reg.histogram("x")
+        h.observe(1000.0)          # t=100
+        clk.advance(50.0)
+        for _ in range(5):
+            h.observe(10.0)        # t=150
+        # 10s window at t=150: only the recent 10s, the 1000 is gone
+        w = h.windowed(10.0)
+        assert w["count"] == 5 and w["p99"] == 10.0 and w["max"] == 10.0
+        # lifetime summary still remembers the spike
+        assert h.summary()["max"] == 1000.0
+        # empty window reports count 0, never stale numbers
+        clk.advance(100.0)
+        assert h.windowed(10.0) == {"count": 0}
+
+    def test_counter_windowed_delta(self):
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        c = reg.counter("tokens")
+        c.inc(5)
+        clk.advance(30.0)
+        c.inc(7)
+        assert c.value == 12
+        assert c.windowed_delta(10.0) == 7      # only the recent inc
+        assert c.windowed_delta(60.0) == 12
+        clk.advance(100.0)
+        assert c.windowed_delta(60.0) == 0
+
+    def test_gauge_windowed_envelope(self):
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        g = reg.gauge("queue_depth")
+        g.set(3.0)
+        clk.advance(5.0)
+        g.set(9.0)
+        w = g.windowed(60.0)
+        assert w == {"count": 2, "last": 9.0, "mean": 6.0,
+                     "min": 3.0, "max": 9.0}
+        g.set(None)  # None never enters the ring
+        assert g.windowed(60.0)["count"] == 2
+
+    def test_windowed_snapshot_shape(self):
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        reg.counter("tokens").inc(30)
+        reg.gauge("q").set(2.0)
+        reg.histogram("ttft_ms").observe(12.0)
+        snap = reg.windowed_snapshot(60.0)
+        assert snap["window_s"] == 60.0
+        assert snap["counters"]["tokens"] == {"delta": 30.0,
+                                              "covered_s": 60.0,
+                                              "per_s": 0.5}
+        assert snap["histograms"]["ttft_ms"]["p99"] == 12.0
+        assert snap["gauges"]["q"]["last"] == 2.0
+        # the lifetime snapshot() wire shape is untouched (pinned
+        # elsewhere by the fixture contract): windows are a SEPARATE
+        # section, not a new key inside it
+        assert set(reg.snapshot()) == {"counters", "gauges",
+                                       "histograms", "labels"}
+
+    def test_truncated_ring_reports_honest_rates(self):
+        """A counter busier than its ring cap covers less history than
+        the asked-for window; the rate must divide by the COVERED
+        span, not the window, or 100 tokens/s reads as 13.65."""
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        c = reg.counter("tokens")
+        for _ in range(10_000):          # 100/s for 100s; ring cap 8192
+            c.inc()
+            clk.advance(0.01)
+        row = reg.windowed_snapshot(600.0)["counters"]["tokens"]
+        assert row["delta"] == 8192.0            # what the ring holds
+        assert row["covered_s"] == pytest.approx(81.92, rel=0.01)
+        assert row["per_s"] == pytest.approx(100.0, rel=0.01)
+        assert c.covered_window_s(600.0) == pytest.approx(81.92,
+                                                          rel=0.01)
+        # a young/idle counter genuinely covers the whole window
+        q = reg.counter("quiet")
+        q.inc(3)
+        assert q.covered_window_s(600.0) == 600.0
+
+    def test_counter_ratio_clamps_to_common_covered_span(self):
+        """Cross-counter ratios (reject rate, availability) must be
+        computed over the span EVERY involved ring still covers — a
+        truncated busy accept stream against an untruncated rare
+        reject stream would otherwise inflate the rate."""
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        rej, acc = reg.counter("serve_rejected"), \
+            reg.counter("serve_accepted")
+        for _ in range(6):               # rejects early, then none
+            rej.inc()
+            clk.advance(1.0)
+        for _ in range(9_000):           # busy accepts: ring wraps
+            acc.inc()
+            clk.advance(0.066)
+        # naive windowed deltas over 600s would count all 6 rejects
+        # against only the RETAINED accepts — an inflated rate
+        assert rej.windowed_delta(600.0) == 6.0
+        assert acc.covered_window_s(600.0) < 600.0
+        # the common covered span excludes the early rejects entirely:
+        # over the history every ring still holds, zero rejects
+        assert slo_mod.serve_window_value(reg, "reject_rate", 600.0) \
+            == 0.0
+
+
+# ------------------------------------------------- burn-rate alerting
+
+
+def _mon(clk, reg, *, fast=10.0, slow=60.0, target=100.0):
+    return slo_mod.SLOMonitor(
+        slo_mod.standard_targets(ttft_p99_ms=target), reg,
+        fast_s=fast, slow_s=slow, eval_every_s=0.0, clock=clk)
+
+
+class TestBurnRate:
+    def test_raise_needs_both_windows(self):
+        """A fast-window spike alone never pages: the slow window must
+        also be burning. Feed one burst, evaluate before the slow
+        window has enough history... both windows see the same burst
+        here, so instead pin the asymmetric case: bad-fast/good-slow."""
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        mon = _mon(clk, reg, fast=10.0, slow=60.0)
+        h = reg.histogram("ttft_ms")
+        # 55s of healthy traffic, then a 5s spike: fast window is all
+        # spike (burn 4x), slow window p99 still rides the spike...
+        # nearest-rank p99 over 60s needs >1% bad to move, so 56
+        # good + 4 bad keeps slow p99 high — use the mass instead:
+        # 56 good then 4 bad puts slow p99 AT the bad value only when
+        # bad >= 1% of count; keep good dominant enough that slow p99
+        # stays good.
+        for _ in range(600):
+            h.observe(10.0)
+            clk.advance(0.1)       # 60s of good, 600 samples
+        for _ in range(5):
+            h.observe(400.0)
+            clk.advance(0.2)       # 1s of bad: fast p99 flips, slow not
+        assert reg.histogram("ttft_ms").windowed(10.0)["p99"] == 400.0
+        assert reg.histogram("ttft_ms").windowed(60.0)["p99"] == 10.0
+        assert mon.evaluate() == []          # slow window vetoes
+        assert not mon.active
+
+    def test_overload_raises_once_then_clears_once(self):
+        """THE seeded drill: sustained overload raises exactly one
+        alert (hovering at 4x burn never re-raises), the load drops,
+        and the alert clears exactly once after BOTH windows drain —
+        no flapping anywhere in between."""
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        mon = _mon(clk, reg, fast=10.0, slow=30.0)
+        tr_log = []
+        for i in range(40):                  # 40s of 400ms TTFTs
+            reg.histogram("ttft_ms").observe(400.0)
+            clk.advance(1.0)
+            tr_log += mon.evaluate()
+        assert [t["kind"] for t in tr_log] == ["raised"]
+        assert tr_log[0]["alert"] == "ttft_p99"
+        assert tr_log[0]["burn_fast"] == pytest.approx(4.0)
+        assert mon.active_names() == ["ttft_p99"]
+        for i in range(60):                  # silence: windows drain
+            clk.advance(1.0)
+            tr_log += mon.evaluate()
+        kinds = [t["kind"] for t in tr_log]
+        assert kinds == ["raised", "cleared"], kinds
+        assert not mon.active
+        assert tr_log[-1]["active_s"] > 0
+
+    def test_hysteresis_holds_at_the_threshold(self):
+        """Values hovering AT the threshold (burn 1.0) raise once and
+        stay raised: clearing demands burn <= clear_ratio (0.9) in
+        both windows, so threshold-hugging load cannot flap."""
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        mon = _mon(clk, reg, fast=5.0, slow=15.0, target=100.0)
+        transitions = []
+        for i in range(60):
+            reg.histogram("ttft_ms").observe(100.0)   # burn exactly 1.0
+            clk.advance(1.0)
+            transitions += mon.evaluate()
+        assert [t["kind"] for t in transitions] == ["raised"]
+        # drop to just above the clear line: still holds
+        for i in range(30):
+            reg.histogram("ttft_ms").observe(95.0)    # burn 0.95 > 0.9
+            clk.advance(1.0)
+            transitions += mon.evaluate()
+        assert [t["kind"] for t in transitions] == ["raised"]
+        # comfortably under the clear ratio: exactly one clear
+        for i in range(30):
+            reg.histogram("ttft_ms").observe(50.0)
+            clk.advance(1.0)
+            transitions += mon.evaluate()
+        assert [t["kind"] for t in transitions] == ["raised", "cleared"]
+
+    def test_reject_rate_and_availability_metrics(self):
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        for _ in range(8):
+            reg.counter("serve_accepted").inc()
+            reg.counter("serve_completed").inc()
+        reg.counter("serve_rejected").inc(2)
+        assert slo_mod.serve_window_value(
+            reg, "reject_rate", 60.0, clk()) == pytest.approx(0.2)
+        assert slo_mod.serve_window_value(
+            reg, "availability", 60.0, clk()) == pytest.approx(0.8)
+        # empty window: None, which burns 0 — silence is compliance
+        clk.advance(120.0)
+        assert slo_mod.serve_window_value(reg, "reject_rate", 60.0,
+                                          clk()) is None
+        assert slo_mod.burn("reject_rate", None, 0.05) == 0.0
+        assert slo_mod.burn("availability", 0.95, 0.99) \
+            == pytest.approx(5.0)
+        with pytest.raises(ValueError):
+            slo_mod.serve_window_value(reg, "nope", 60.0, clk())
+
+    def test_evaluate_is_rate_limited(self):
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        mon = slo_mod.SLOMonitor(
+            slo_mod.standard_targets(ttft_p99_ms=100.0), reg,
+            fast_s=10.0, slow_s=30.0, clock=clk)  # default cadence
+        for _ in range(6):               # past the quantile floor
+            reg.histogram("ttft_ms").observe(400.0)
+        assert mon.evaluate() != []      # first call always evaluates
+        clk.advance(0.01)
+        reg.histogram("ttft_ms").observe(400.0)
+        assert mon.evaluate() == []      # inside the gap: no work
+        assert mon.active_names() == ["ttft_p99"]
+
+    def test_single_bad_request_never_pages(self):
+        """The quantile evidence floor: one cold 600ms TTFT in an
+        otherwise-idle window is NOT a p99 breach — the windowed p99
+        of one sample is that sample, and paging on it would break
+        the 'single bad second never pages' contract."""
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        mon = _mon(clk, reg, fast=10.0, slow=30.0, target=500.0)
+        reg.histogram("ttft_ms").observe(600.0)  # one cold request
+        assert mon.evaluate() == [] and not mon.active
+        # sustained slow traffic past the floor DOES page
+        for _ in range(slo_mod.QUANTILE_MIN_COUNT):
+            clk.advance(1.0)
+            reg.histogram("ttft_ms").observe(600.0)
+        (tr,) = mon.evaluate()
+        assert tr["kind"] == "raised"
+
+    def test_publish_emits_standard_vocabulary(self, tmp_path):
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        mon = _mon(clk, reg, fast=5.0, slow=10.0)
+        t = Tracer(tmp_path / "telemetry.jsonl", run="slo_t", proc=0)
+        for _ in range(6):
+            reg.histogram("ttft_ms").observe(400.0)
+        trs = mon.evaluate()
+        slo_mod.publish(trs, t, reg, step=3, active=len(mon.active))
+        t.close()
+        recs = [json.loads(line) for line in
+                (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+        (ev,) = [r for r in recs if r["name"] == "alert_raised"]
+        assert ev["alert"] == "ttft_p99" and ev["step"] == 3
+        assert ev["threshold"] == 100.0 and ev["burn_fast"] == 4.0
+        assert reg.counter("serve_alerts_raised").value == 1
+        assert reg.gauge("serve_alerts_active").value == 1.0
+
+
+# -------------------------------------------------- exposition socket
+
+
+class TestExposition:
+    def test_round_trip(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("tokens").inc(42)
+        reg.histogram("ttft_ms").observe(7.0)
+
+        def payload():
+            return {"role": "engine", "phase": "serve", "active": 1,
+                    "metrics": reg.snapshot(),
+                    "windows": reg.windowed_snapshot(60.0)}
+
+        sock = exposition_path(tmp_path)
+        assert sock == tmp_path / "obs.sock"
+        with MetricsExporter(sock, payload, label="t-obs") as exp:
+            assert exp.enabled
+            doc = read_exposition(sock)
+            assert doc["kind"] == "exposition" and doc["v"] == 1
+            assert doc["phase"] == "serve"
+            assert doc["metrics"]["counters"]["tokens"] == 42
+            assert doc["windows"]["histograms"]["ttft_ms"]["p99"] == 7.0
+            assert isinstance(doc["pid"], int)
+            # a second request gets a fresh answer (one per connection)
+            assert read_exposition(sock) is not None
+        # closed: socket unlinked, reads degrade to None
+        assert not sock.exists()
+        assert read_exposition(sock) is None
+
+    def test_payload_error_answers_instead_of_killing(self, tmp_path):
+        def bad():
+            raise RuntimeError("boom")
+
+        with MetricsExporter(tmp_path / "obs.sock", bad) as exp:
+            doc = read_exposition(tmp_path / "obs.sock")
+            assert "boom" in doc["error"]
+            assert exp.enabled  # the exporter survived its own bug
+
+    def test_stale_socket_file_is_reclaimed(self, tmp_path):
+        sock = tmp_path / "obs.sock"
+        sock.touch()  # a crash leftover nobody is listening on
+        with MetricsExporter(sock, lambda: {"ok": True}):
+            assert read_exposition(sock)["ok"] is True
+
+    def test_read_nothing_is_none(self, tmp_path):
+        assert read_exposition(tmp_path / "absent.sock") is None
+
+    def test_refused_exporter_close_leaves_owner_socket(self, tmp_path):
+        """A second exporter pointed at a LIVE socket is refused and
+        degrades — and its close() must NOT unlink the rightful
+        owner's socket on the way out."""
+        sock = tmp_path / "obs.sock"
+        first = MetricsExporter(sock, lambda: {"who": "first"}).start()
+        try:
+            second = MetricsExporter(sock,
+                                     lambda: {"who": "second"}).start()
+            assert not second.enabled     # refused, degraded
+            second.close()
+            doc = read_exposition(sock)   # the owner still answers
+            assert doc is not None and doc["who"] == "first"
+        finally:
+            first.close()
+        assert not sock.exists()          # the binder cleaned up
+
+    def test_exposition_path_from_file_anchor(self, tmp_path):
+        assert exposition_path(tmp_path / "heartbeat.json") \
+            == tmp_path / "obs.sock"
+        assert exposition_path(tmp_path / "telemetry.jsonl") \
+            == tmp_path / "obs.sock"
+
+
+# ------------------------------------------------------------ obs top
+
+
+def _fake_fleet(base: Path) -> None:
+    """A router-layout dir: router heartbeat at the base, replica_0
+    live behind a real exposition socket, replica_1 dead (stale
+    heartbeat only), replica_2 never beat."""
+    base.mkdir(parents=True, exist_ok=True)
+    now = time.time()
+    (base / "heartbeat.json").write_text(json.dumps(
+        {"v": 1, "schema": 1, "run": "route_x", "pid": 42, "proc": 0,
+         "step": 9, "phase": "route", "t_wall": now, "t_mono": 1.0,
+         "beats": 3, "active": 1, "queue": 0, "alerts": []}))
+    for i in range(3):
+        (base / f"replica_{i}").mkdir(exist_ok=True)
+    (base / "replica_1" / "heartbeat.json").write_text(json.dumps(
+        {"v": 1, "schema": 1, "run": "serve_r1_1", "pid": 43, "proc": 1,
+         "step": 17, "phase": "serve", "t_wall": now - 3600,
+         "t_mono": 5.0, "beats": 9, "active": 2, "queue": 4,
+         "alerts": ["ttft_p99"]}))
+
+
+@pytest.fixture()
+def live_fleet(tmp_path):
+    base = tmp_path / "fleet"
+    _fake_fleet(base)
+    reg = MetricsRegistry()
+    reg.counter("tokens").inc(120)
+    reg.histogram("ttft_ms").observe(12.5)
+
+    def payload():
+        return {"role": "engine", "run": "serve_r0_1", "phase": "serve",
+                "tick": 33, "active": 1, "slots": 2, "occupancy": 0.5,
+                "queue": 1, "draining": False, "brownout": True,
+                "blocks_in_use": 6, "blocks_free": 10,
+                "alerts": ["reject_rate"],
+                "metrics": reg.snapshot(),
+                "windows": reg.windowed_snapshot(60.0)}
+
+    exp = MetricsExporter(base / "replica_0" / "obs.sock",
+                          payload).start()
+    try:
+        yield base
+    finally:
+        exp.close()
+
+
+class TestObsTop:
+    def test_discovery_orders_router_then_replicas(self, live_fleet):
+        names = [n for n, _ in top_mod.discover(live_fleet)]
+        assert names == ["router", "replica 0", "replica 1",
+                         "replica 2"]
+
+    def test_once_json_rows(self, live_fleet, capsys):
+        from hyperion_tpu.cli.main import main as cli_main
+
+        rc = cli_main(["obs", "top", str(live_fleet), "--once", "--json",
+                       "--stale-s", "30"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        rows = {r["name"]: r for r in doc["rows"]}
+        assert set(rows) == {"router", "replica 0", "replica 1",
+                             "replica 2"}
+        for r in doc["rows"]:   # the stable key contract
+            assert set(top_mod.ROW_KEYS) <= set(r)
+        live = rows["replica 0"]
+        assert live["source"] == "socket" and live["state"] == "live"
+        assert live["occupancy"] == 0.5 and live["queue"] == 1
+        assert live["ttft_p99_ms"] == 12.5
+        assert live["tokens_per_s"] == 2.0      # 120 tokens / 60s window
+        assert live["brownout"] is True
+        assert live["alerts"] == ["reject_rate"]
+        assert live["blocks_in_use"] == 6
+        dead = rows["replica 1"]
+        assert dead["source"] == "heartbeat" and dead["state"] == "dead"
+        assert dead["active"] == 2 and dead["queue"] == 4
+        assert dead["alerts"] == ["ttft_p99"]
+        assert dead["age_s"] > 1000
+        assert rows["replica 2"]["state"] == "no heartbeat"
+        assert rows["router"]["state"] == "beating"  # hb fresh, no sock
+
+    def test_render_flags_dead_and_alerts(self, live_fleet):
+        rows = top_mod.sample_all(live_fleet, stale_s=30.0)
+        out = top_mod.render(rows, str(live_fleet), window_s=60.0,
+                             color=False)
+        assert "replica 1" in out and "dead" in out
+        assert "reject_rate" in out
+        assert "DEAD:" in out and "alerts firing:" in out
+
+    def test_empty_target_exits_2(self, tmp_path, capsys):
+        assert top_mod.main([str(tmp_path / "nothing"), "--once"]) == 2
+        assert "nothing to watch" in capsys.readouterr().err
+
+    def test_json_without_once_exits_2(self, live_fleet, capsys):
+        assert top_mod.main([str(live_fleet), "--json"]) == 2
+        assert "--once" in capsys.readouterr().err
+
+    def test_smoke_script_top_invocation_parses(self):
+        """Flag-drift guard (the capture-script pattern): the smoke
+        script's `obs top` probe must parse against the real arg
+        surface."""
+        import re
+        import shlex
+
+        script = (REPO / "scripts" / "serve_smoke.sh").read_text()
+        script = re.sub(r"\\\n\s*", " ", script)
+        calls = re.findall(
+            r"python -m hyperion_tpu\.cli\.main obs top\s+(.*)", script)
+        assert calls, "serve_smoke.sh lost its obs top probe"
+        for call in calls:
+            toks = shlex.split(call.split(">")[0])
+            args = top_mod.build_parser().parse_args(
+                [re.sub(r"\$\{?\w+\}?", "x", t) for t in toks])
+            assert args.once and args.json  # the scripted probe mode
+
+
+# ----------------------------------------- doctor + diff consumption
+
+
+class TestAlertConsumers:
+    def test_doctor_names_cleared_alert_on_golden_fixture(self):
+        from hyperion_tpu.obs import doctor
+
+        d = doctor.diagnose(FIXTURES / "slo")
+        assert d["verdict"] == "healthy"
+        assert "slo:" in d["reason"] and "ttft_p99" in d["reason"]
+        (row,) = d["slo_alerts"]
+        assert row["alert"] == "ttft_p99"
+        assert row["raised"] == 1 and row["cleared"] == 1
+        assert row["active"] is False
+        assert d["serve"]["alerts_raised"] == 1
+        md = doctor.render_markdown(d)
+        assert "SLO alert `ttft_p99`" in md and "(cleared)" in md
+
+    def test_doctor_flags_still_firing_alert(self, tmp_path):
+        from hyperion_tpu.obs import doctor
+
+        t = Tracer(tmp_path / "telemetry.jsonl", run="fire", proc=0)
+        t.event("serve_start", slots=2)
+        t.event("alert_raised", alert="reject_rate",
+                metric="reject_rate", threshold=0.05, fast=0.4,
+                slow=0.3, burn_fast=8.0, burn_slow=6.0)
+        t.close()
+        d = doctor.diagnose(tmp_path)
+        assert "FIRING" in d["reason"] and "reject_rate" in d["reason"]
+        assert d["slo_alerts"][0]["active"] is True
+        assert "**FIRING**" in doctor.render_markdown(d)
+        # exit-code contract unchanged: a firing alert is evidence on
+        # the verdict, not a new verdict
+        assert d["verdict"] in ("running", "hung")
+
+    def test_doctor_flap_that_ends_firing_counts_its_clears(
+            self, tmp_path):
+        from hyperion_tpu.obs import doctor
+
+        t = Tracer(tmp_path / "telemetry.jsonl", run="flap", proc=0)
+        for name in ("alert_raised", "alert_cleared", "alert_raised"):
+            t.event(name, alert="ttft_p99", metric="ttft_p99_ms",
+                    threshold=100.0, fast=400.0, active_s=1.0)
+        t.close()
+        d = doctor.diagnose(tmp_path)
+        (row,) = d["slo_alerts"]
+        assert row["raised"] == 2 and row["cleared"] == 1
+        assert row["active"] is True
+        # the incident text must not claim "never cleared"
+        assert "cleared 1x, re-raised" in d["reason"]
+        assert "never cleared" not in d["reason"]
+
+    def test_doctor_json_carries_alert_keys(self, capsys):
+        from hyperion_tpu.obs import doctor
+
+        assert doctor.main([str(FIXTURES / "slo"), "--json"]) == 0
+        d = json.loads(capsys.readouterr().out)
+        for key in ("verdict", "reason", "serve", "slo_alerts",
+                    "slo_incidents", "fleet", "heartbeat"):
+            assert key in d
+        assert d["slo_alerts"][0]["alert"] == "ttft_p99"
+
+    def test_diff_gates_alerts_raised(self):
+        from hyperion_tpu.obs import diff as obs_diff
+
+        row = {"metric": "matmul", "value": 1.0,
+               "serving": {"tokens_per_s": 100.0, "alerts_raised": 1}}
+        worse = {"metric": "matmul", "value": 1.0,
+                 "serving": {"tokens_per_s": 100.0, "alerts_raised": 3}}
+        a = {"label": "a", "metrics": obs_diff.normalize(row)}
+        b = {"label": "b", "metrics": obs_diff.normalize(worse)}
+        assert a["metrics"]["serve_alerts_raised"] == 1.0
+        d = obs_diff.diff(a, b)
+        assert "serve_alerts_raised" in d["regressions"]
+        assert obs_diff.METRICS["serve_alerts_raised"] == "lower"
+        # and fewer alerts is an improvement, not a regression
+        d = obs_diff.diff(b, a)
+        assert "serve_alerts_raised" not in d["regressions"]
+
+    def test_diff_json_stable_keys(self, tmp_path, capsys):
+        """The machine-readable satellite: `obs diff --json` keys are
+        a stable contract (CI parses them), exit codes unchanged."""
+        from hyperion_tpu.obs import diff as obs_diff
+
+        a, b = tmp_path / "a.json", tmp_path / "b.json"
+        a.write_text(json.dumps({"step_ms": 10.0, "tokens_per_s": 100.0}))
+        b.write_text(json.dumps({"step_ms": 20.0, "tokens_per_s": 100.0}))
+        rc = obs_diff.main([str(a), str(b), "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1  # regression still flips the exit code
+        for key in ("a", "b", "threshold_pct", "rows", "regressions",
+                    "comparable_metrics"):
+            assert key in doc
+        assert doc["regressions"] == ["step_time_mean_ms"]

@@ -295,6 +295,10 @@ import json, os, socket, socketserver, sys, threading, time
 sock_path, hb_path = sys.argv[1], sys.argv[2]
 die_after = int(sys.argv[3]) if len(sys.argv) > 3 else -1
 attempt = int(os.environ.get("HYPERION_ATTEMPT", "0") or 0)
+# FAKE_ALERT=1: report a firing SLO alert on every beat, the way a
+# real engine's obs/slo.py monitor would — exercises the router's
+# fleet-alert tally without a real overload
+alerts = ["ttft_p99"] if os.environ.get("FAKE_ALERT") else []
 
 def beats():
     n = 0
@@ -302,14 +306,45 @@ def beats():
         n += 1
         tmp = hb_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"v": 1, "run": "fake", "pid": os.getpid(),
+            json.dump({"v": 1, "schema": 1, "run": "fake",
+                       "pid": os.getpid(),
                        "phase": "serve", "t_wall": time.time(),
                        "t_mono": time.monotonic(), "beats": n,
-                       "active": 0, "queue": 0}, f)
+                       "active": 0, "queue": 0, "alerts": alerts}, f)
         os.replace(tmp, hb_path)
         time.sleep(0.1)
 
 threading.Thread(target=beats, daemon=True).start()
+
+# inline exposition socket speaking the obs/export.py one-line wire
+# protocol (the fake stays import-free): obs.sock next to the
+# heartbeat, one JSON snapshot per connection — `obs top` reads the
+# fleet through these
+def expo():
+    obs_path = os.path.join(os.path.dirname(hb_path), "obs.sock")
+    class E(socketserver.StreamRequestHandler):
+        def handle(self):
+            self.wfile.write((json.dumps({
+                "v": 1, "kind": "exposition", "pid": os.getpid(),
+                "t_wall": time.time(), "role": "engine",
+                "phase": "serve", "tick": 7, "active": 1, "slots": 2,
+                "occupancy": 0.5, "queue": 0, "draining": False,
+                "brownout": False, "blocks_in_use": 3,
+                "alerts": alerts,
+                "metrics": {"gauges": {"tokens_per_s": 42.0}},
+                "windows": {"window_s": 60.0,
+                            "histograms": {"ttft_ms": {"count": 5,
+                                                       "p99": 12.5}},
+                            "counters": {"tokens": {"delta": 60,
+                                                    "per_s": 1.0}}},
+            }) + "\n").encode())
+    class ES(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
+        daemon_threads = True
+    if os.path.exists(obs_path):
+        os.unlink(obs_path)
+    ES(obs_path, E).serve_forever()
+
+threading.Thread(target=expo, daemon=True).start()
 
 def tok(psum, seed, i):
     return (psum * 31 + seed * 7 + i * 13) % 1000
@@ -624,14 +659,23 @@ class TestObsIntegration:
         script = re.sub(r"\\\n\s*", " ", script)
         calls = re.findall(
             r"python -m hyperion_tpu\.cli\.main route\s+(.*)", script)
-        assert calls, "serve_smoke.sh lost its router round trip"
+        assert len(calls) >= 2, (
+            "serve_smoke.sh lost a router invocation (expected the "
+            "crash drill AND the live obs top fleet)")
+        parsed = []
         for call in calls:
-            toks = [t for t in shlex.split(call.split(">")[0])
-                    if t != "|"]
+            # strip shell artifacts: stderr redirects (` 2> file`),
+            # stdout redirects, pipes, backgrounding
+            call = re.split(r"\s2>", call)[0].split(">")[0]
+            toks = [t for t in shlex.split(call) if t not in ("|", "&")]
             args = build_parser().parse_args(
                 [re.sub(r"\$\{?\w+\}?", "x", t) for t in toks])
             assert args.replicas >= 2
-            assert args.replica_chaos  # the kill-one-mid-stream drill
+            parsed.append(args)
+        # the crash drill still carries its chaos plan, and the live
+        # fleet probe carries an SLO target for the alert plane
+        assert any(a.replica_chaos for a in parsed)
+        assert any(a.slo_ttft_p99_ms > 0 for a in parsed)
 
 
 # ------------------------------------------------- acceptance drill
@@ -740,3 +784,123 @@ class TestRouteAcceptance:
 
         assert RequestJournal(
             base / "replica_0" / "journal.jsonl").pending_count() == 0
+
+
+# ------------------------------------------- live fleet observability
+
+
+class TestLiveFleetObservability:
+    """`obs top` + fleet alert surfacing over the REAL router runtime
+    (fake replicas speaking the exposition wire protocol) — zero jit
+    compiles, like the rest of the runtime tests."""
+
+    def test_obs_top_reads_running_fleet_sockets(self, tmp_path,
+                                                 fake_replica_script):
+        from hyperion_tpu.obs.top import sample_all
+
+        router = _mk_router(tmp_path, fake_replica_script, n=2)
+        try:
+            router.start()
+            assert router.wait_ready(2, timeout_s=20)
+            deadline = time.monotonic() + 20
+            while True:
+                rows = sample_all(tmp_path / "fleet")
+                live = [r for r in rows if r["state"] == "live"]
+                if len(live) == 2:
+                    break
+                assert time.monotonic() < deadline, rows
+                time.sleep(0.2)
+            for r in live:
+                # the live columns come off the exposition socket, not
+                # the heartbeat file
+                assert r["source"] == "socket"
+                assert r["occupancy"] == 0.5
+                assert r["ttft_p99_ms"] == 12.5
+                assert r["tokens_per_s"] == 1.0
+                assert r["blocks_in_use"] == 3
+                assert r["alerts"] == []
+        finally:
+            router._hard_stop.set()
+            router.shutdown()
+        # fleet stopped: the sockets stop answering and the SAME
+        # sampler degrades every row to its heartbeat file
+        rows = sample_all(tmp_path / "fleet", stale_s=3600.0)
+        assert rows and all(r["source"] != "socket" for r in rows)
+
+    def test_router_tallies_fleet_alerts(self, tmp_path,
+                                         fake_replica_script,
+                                         monkeypatch):
+        monkeypatch.setenv("FAKE_ALERT", "1")
+        router = _mk_router(tmp_path, fake_replica_script, n=2)
+        try:
+            router.start()
+            assert router.wait_ready(2, timeout_s=20)
+            deadline = time.monotonic() + 10
+            while router.metrics.summary()["fleet_alerts_raised"] < 2:
+                assert time.monotonic() < deadline, \
+                    router.metrics.summary()
+                time.sleep(0.1)
+            s = router.metrics.summary()
+            assert s["fleet_alerts_raised"] == 2   # one raise per replica
+            assert s["fleet_alerts_active"] == 2
+            exp = router.exposition()
+            assert exp["role"] == "router"
+            assert sorted(exp["alerts"]) == ["r0:ttft_p99",
+                                             "r1:ttft_p99"]
+            assert all(r["alerts"] == ["ttft_p99"]
+                       for r in exp["replicas"])
+            # a PERSISTING alert never re-counts on later beats — the
+            # tally is raises, not beat-observations
+            time.sleep(0.5)
+            assert router.metrics.summary()["fleet_alerts_raised"] == 2
+        finally:
+            router._hard_stop.set()
+            router.shutdown()
+
+    def test_dead_replica_alert_stops_counting(self, tmp_path):
+        """A ghost must not page: an ejected/dead replica's
+        last-reported alert leaves the live fleet tally (the dead
+        replica itself is the incident), and a readmitted replica
+        still alerting counts as a NEW raise."""
+        router = _mk_router(tmp_path, tmp_path / "unused.py", n=2)
+        r0, r1 = router.replicas
+        r0.state = READY
+        r0.hb_alerts = ("ttft_p99",)
+        r1.state = READY
+        assert router._sweep_fleet_alerts() == ["r0:ttft_p99"]
+        assert router.metrics.summary()["fleet_alerts_raised"] == 1
+        assert router.exposition()["alerts"] == ["r0:ttft_p99"]
+        # the replica dies: its stale alarm stops counting fleet-wide
+        r0.state = EJECTED
+        assert router._sweep_fleet_alerts() == []
+        assert router.exposition()["alerts"] == []
+        # ...but the per-replica evidence row keeps the last word
+        row0 = router.exposition()["replicas"][0]
+        assert row0["state"] == EJECTED
+        assert row0["alerts"] == ["ttft_p99"]
+        # readmitted and still alerting: a new observation epoch —
+        # honestly re-raised, not deduped against the old life
+        r0.state = READY
+        assert router._sweep_fleet_alerts() == ["r0:ttft_p99"]
+        assert router.metrics.summary()["fleet_alerts_raised"] == 2
+
+    def test_route_slo_monitor_fires_on_fleet_rejects(self, tmp_path):
+        """The router-level burn-rate monitor (route_ prefix) over its
+        own windowed relay outcomes — pure host logic, no children."""
+        from hyperion_tpu.obs.registry import MetricsRegistry
+        from hyperion_tpu.obs.slo import SLOMonitor, SLOTarget
+        from hyperion_tpu.serve.router import _route_window_value
+
+        reg = MetricsRegistry()
+        mon = SLOMonitor(
+            (SLOTarget("route_reject_rate", "reject_rate", 0.1),),
+            reg, fast_s=10.0, slow_s=30.0, eval_every_s=0.0,
+            value_fn=_route_window_value)
+        for _ in range(8):
+            reg.counter("route_completed").inc()
+        assert mon.evaluate() == []          # 0% rejects: quiet
+        for _ in range(4):
+            reg.counter("route_rejected").inc()
+        (tr,) = mon.evaluate()
+        assert tr["kind"] == "raised" and tr["alert"] == "route_reject_rate"
+        assert tr["fast"] == pytest.approx(1 / 3)
