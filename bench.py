@@ -325,6 +325,40 @@ def _child_serving() -> None:
     engine.warmup([shared + p for p in spec.prompt_lens])
     report = run_load(engine, spec)
     report["compile"] = engine.compile_stats()
+
+    # ---- the @spec dimension: speculative decoding off vs k∈{2,4} on
+    # a longer-decode cut of the SAME seeded shared-prefix workload
+    # (speculation pays on decode ticks; the base row's 4-12 token
+    # budgets are prefill-dominated, so the sweep stretches max_new to
+    # where the tick count actually lives). Fresh engine per point —
+    # the jit caches are process-wide, so each extra point costs one
+    # spec-tick compile, nothing else. accept_rate/tokens_per_tick
+    # from the k=4 point ride the row top-level for `obs diff`
+    # (higher-is-better); the off point pins the sequential baseline
+    # (tokens_per_tick == 1.0 by construction).
+    spec_load = LoadSpec(n_requests=16, rate_hz=100.0,
+                         prompt_lens=(4, 8, 16), max_new=(24, 32, 48),
+                         vocab=cfg.vocab_size, seed=0,
+                         shared_prefix_tokens=shared)
+    report["spec"] = {}
+    for label, k in (("off", 0), ("k2", 2), ("k4", 4)):
+        eng = Engine(
+            model, {"params": params},
+            EngineConfig(slots=4, max_len=128, eos_id=None,
+                         queue_capacity=8, prefill_budget=96,
+                         spec_k=k, draft="ngram" if k else "off"),
+        )
+        eng.warmup([shared + p for p in spec_load.prompt_lens])
+        r = run_load(eng, spec_load)
+        report["spec"][label] = {
+            key: r.get(key)
+            for key in ("tokens_per_s", "tokens_per_tick", "accept_rate",
+                        "spec_drafted", "spec_accepted", "spec_rejected",
+                        "ttft_p99_ms", "e2e_p99_ms", "completed")
+        }
+        if label == "k4":
+            report["accept_rate"] = r.get("accept_rate")
+            report["tokens_per_tick"] = r.get("tokens_per_tick")
     print(json.dumps(report))
 
 

@@ -21,11 +21,16 @@ round, which plugs the one cache gap full acceptance would leave
 (recomputing an existing entry writes identical K/V, so the rewrite is
 idempotent).
 
-Scope: batch 1 (a latency optimization; per-row acceptance counts would
-need per-row cache indices, which the static cache API keeps scalar)
-and greedy (temperature 0) — the regime where the equality guarantee
-is exact. Prompt must be longer than `k` tokens (the draft's re-feed
-window reaches k positions back).
+Scope: greedy (temperature 0) — the regime where the equality
+guarantee is exact. Prompts must be longer than `k` tokens (the
+draft's re-feed window reaches k positions back). Batching: each row
+runs the single-sequence routine under `vmap` (rows finish their
+rounds independently; the loop's carry updates are masked per row by
+the batching rule), so a batch decodes in lock-step rounds while each
+row's token stream stays exactly the single-sequence stream. The
+acceptance rule itself lives in `accept_draft`, shared with the serve
+engine's speculative tick (`serve/engine.py`), which applies it per
+slot over a `[S, k+1]` verify window.
 
 Reference for the technique: Leviathan et al. 2023 / Chen et al. 2023
 (public); implementation is original to this repo.
@@ -47,6 +52,30 @@ def _argmax_tok(logits: jax.Array) -> jax.Array:
     return sample_token(logits, None)
 
 
+def accept_draft(draft: jax.Array, target: jax.Array
+                 ) -> tuple[jax.Array, jax.Array]:
+    """THE speculative acceptance rule, shared by this module and the
+    serve engine's per-slot spec tick: longest draft prefix agreeing
+    with the target, plus the target's own token at the first
+    disagreement (or its bonus token after full acceptance).
+
+    `draft` [..., k] are the proposals for the next k positions;
+    `target` [..., k+1] are the tokens the target model itself would
+    emit at those k+1 positions (argmax for greedy — any leading batch
+    dims broadcast row-wise). Returns `(m, v)`: `m` [...] counts the
+    accepted proposals (0..k), and `v` [..., k+1] holds the decided
+    tokens — positions <= m are exactly the tokens sequential decoding
+    with the target alone would produce (the bit-identity guarantee);
+    positions above m are junk a caller must never emit."""
+    k = draft.shape[-1]
+    matches = draft == target[..., :k]
+    m = jnp.where(matches.all(axis=-1), k,
+                  jnp.argmin(matches, axis=-1)).astype(jnp.int32)
+    ext = jnp.concatenate([draft, jnp.zeros_like(draft[..., :1])], axis=-1)
+    v = jnp.where(jnp.arange(k + 1) == m[..., None], target, ext)
+    return m, v
+
+
 def generate_speculative(
     model: Any,
     variables: dict,
@@ -59,21 +88,24 @@ def generate_speculative(
     eos_id: int | None = None,
     pad_id: int = 0,
 ) -> jax.Array:
-    """Greedy speculative decode → ids [1, max_new_tokens], identical
-    to `generate(model, ...)` at temperature 0.
+    """Greedy speculative decode → ids [B, max_new_tokens], row-wise
+    identical to `generate(model, ...)` at temperature 0.
 
     Both models must share a vocabulary and support the KV-cache call
     signature (`cache`/`cache_index` — Llama here). `k` is the number
     of draft proposals per round; each round costs one draft window
-    pass + (k-1) draft steps + ONE target pass over k+1 tokens.
+    pass + (k-1) draft steps + ONE target pass over k+1 tokens. Batch
+    rows decode independently (vmap over the single-row routine); the
+    batch-1 path bypasses vmap entirely so the original single-sequence
+    output stays byte-identical.
     """
     # lazy model import: keep `import hyperion_tpu.infer` light
     # (generate.py follows the same pattern)
     from hyperion_tpu.models.llama import init_cache
 
     B, P = prompt_ids.shape
-    if B != 1:
-        raise ValueError(f"speculative decode is batch-1 (got batch {B})")
+    if B < 1:
+        raise ValueError(f"need at least one row (got batch {B})")
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
     if P <= k:
@@ -96,81 +128,89 @@ def generate_speculative(
             f"{min(cfg_t.max_len, cfg_d.max_len)}"
         )
 
-    t_cache = init_cache(cfg_t, 1, max_len=L)
-    d_cache = init_cache(cfg_d, 1, max_len=L)
-    # prefill both models; the first generated token comes from the
-    # target (position P), exactly as in plain `generate`
-    t_logits, t_cache = model.apply(
-        variables, prompt_ids, cache=t_cache, cache_index=0
-    )
-    _, d_cache = draft_model.apply(
-        draft_variables, prompt_ids, cache=d_cache, cache_index=0
-    )
-    tok0 = _argmax_tok(t_logits[:, -1])  # [1]
-
-    seq = jnp.zeros((1, L), jnp.int32)
-    seq = jax.lax.dynamic_update_slice(seq, prompt_ids.astype(jnp.int32), (0, 0))
-    seq = seq.at[0, P].set(tok0[0])
-
-    def round_(carry):
-        seq, t_cache, d_cache, idx, n_gen = carry
-        # ---- draft: re-feed the (k+1)-window ending at idx, then
-        # propose k tokens with k-1 single steps. The window rewrite
-        # repairs any entries a full-acceptance round left unwritten.
-        window = jax.lax.dynamic_slice(seq, (0, idx - k), (1, k + 1))
-        d_logits, d_cache = draft_model.apply(
-            draft_variables, window, cache=d_cache, cache_index=idx - k
-        )
-        d1 = _argmax_tok(d_logits[:, -1])  # proposal for position idx+1
-
-        def d_step(carry, i):
-            d_cache, tok = carry
-            logits, d_cache = draft_model.apply(
-                draft_variables, tok[:, None], cache=d_cache,
-                cache_index=idx + 1 + i,
-            )
-            nxt = _argmax_tok(logits[:, 0])
-            return (d_cache, nxt), tok
-
-        (d_cache, d_last), d_prev = jax.lax.scan(
-            d_step, (d_cache, d1), jnp.arange(k - 1)
-        )
-        # d_arr[i] = proposal for position idx+1+i, i = 0..k-1
-        d_arr = jnp.concatenate([d_prev.reshape(-1), d_last.reshape(-1)]) \
-            if k > 1 else d1.reshape(-1)
-
-        # ---- target: ONE pass over [tok, d_1..d_k] scores every
-        # proposal; row i predicts position idx+1+i
-        verify = jnp.concatenate(
-            [jax.lax.dynamic_slice(seq, (0, idx), (1, 1)), d_arr[None, :]],
-            axis=1,
-        )
+    def _row(row_ids: jax.Array) -> jax.Array:
+        # the original single-sequence routine, over ONE row [P] →
+        # [max_new_tokens]; batch rows each run it under vmap below
+        prompt = row_ids[None, :]
+        t_cache = init_cache(cfg_t, 1, max_len=L)
+        d_cache = init_cache(cfg_d, 1, max_len=L)
+        # prefill both models; the first generated token comes from the
+        # target (position P), exactly as in plain `generate`
         t_logits, t_cache = model.apply(
-            variables, verify, cache=t_cache, cache_index=idx
+            variables, prompt, cache=t_cache, cache_index=0
         )
-        t_arr = _argmax_tok(t_logits[0])  # [k+1]
+        _, d_cache = draft_model.apply(
+            draft_variables, prompt, cache=d_cache, cache_index=0
+        )
+        tok0 = _argmax_tok(t_logits[:, -1])  # [1]
 
-        # ---- greedy acceptance: longest agreeing prefix + the
-        # target's own token at the first disagreement (or the bonus
-        # token after full acceptance)
-        matches = d_arr == t_arr[:k]
-        m = jnp.where(matches.all(), k, jnp.argmin(matches)).astype(jnp.int32)
-        # v[i] decided for i <= m: proposals below m (== target tokens),
-        # the target's correction/bonus at m; junk above m is
-        # overwritten by later rounds before anything reads it
-        d_ext = jnp.concatenate([d_arr, jnp.zeros((1,), jnp.int32)])
-        v = jnp.where(jnp.arange(k + 1) == m, t_arr, d_ext)
-        seq = jax.lax.dynamic_update_slice(seq, v[None, :], (0, idx + 1))
-        return seq, t_cache, d_cache, idx + m + 1, n_gen + m + 1
+        seq = jnp.zeros((1, L), jnp.int32)
+        seq = jax.lax.dynamic_update_slice(
+            seq, prompt.astype(jnp.int32), (0, 0))
+        seq = seq.at[0, P].set(tok0[0])
 
-    def cond(carry):
-        *_, n_gen = carry
-        return n_gen < max_new_tokens
+        def round_(carry):
+            seq, t_cache, d_cache, idx, n_gen = carry
+            # ---- draft: re-feed the (k+1)-window ending at idx, then
+            # propose k tokens with k-1 single steps. The window
+            # rewrite repairs any entries a full-acceptance round left
+            # unwritten.
+            window = jax.lax.dynamic_slice(seq, (0, idx - k), (1, k + 1))
+            d_logits, d_cache = draft_model.apply(
+                draft_variables, window, cache=d_cache, cache_index=idx - k
+            )
+            d1 = _argmax_tok(d_logits[:, -1])  # proposal for idx+1
 
-    seq, *_ = jax.lax.while_loop(
-        cond, round_, (seq, t_cache, d_cache, jnp.int32(P), jnp.int32(1))
-    )
-    out = jax.lax.dynamic_slice(seq, (0, P), (1, max_new_tokens))
+            def d_step(carry, i):
+                d_cache, tok = carry
+                logits, d_cache = draft_model.apply(
+                    draft_variables, tok[:, None], cache=d_cache,
+                    cache_index=idx + 1 + i,
+                )
+                nxt = _argmax_tok(logits[:, 0])
+                return (d_cache, nxt), tok
+
+            (d_cache, d_last), d_prev = jax.lax.scan(
+                d_step, (d_cache, d1), jnp.arange(k - 1)
+            )
+            # d_arr[i] = proposal for position idx+1+i, i = 0..k-1
+            d_arr = jnp.concatenate(
+                [d_prev.reshape(-1), d_last.reshape(-1)]) \
+                if k > 1 else d1.reshape(-1)
+
+            # ---- target: ONE pass over [tok, d_1..d_k] scores every
+            # proposal; row i predicts position idx+1+i
+            verify = jnp.concatenate(
+                [jax.lax.dynamic_slice(seq, (0, idx), (1, 1)),
+                 d_arr[None, :]],
+                axis=1,
+            )
+            t_logits, t_cache = model.apply(
+                variables, verify, cache=t_cache, cache_index=idx
+            )
+            t_arr = _argmax_tok(t_logits[0])  # [k+1]
+
+            # ---- the shared acceptance rule: v[i] decided for
+            # i <= m; junk above m is overwritten by later rounds
+            # before anything reads it
+            m, v = accept_draft(d_arr, t_arr)
+            seq = jax.lax.dynamic_update_slice(seq, v[None, :], (0, idx + 1))
+            return seq, t_cache, d_cache, idx + m + 1, n_gen + m + 1
+
+        def cond(carry):
+            *_, n_gen = carry
+            return n_gen < max_new_tokens
+
+        seq, *_ = jax.lax.while_loop(
+            cond, round_, (seq, t_cache, d_cache, jnp.int32(P), jnp.int32(1))
+        )
+        return jax.lax.dynamic_slice(seq, (0, P), (1, max_new_tokens))[0]
+
+    # batch-1 bypasses vmap: the exact original trace, so the
+    # single-sequence output is byte-identical to the pre-batch code
+    # (the regression test pins it against `generate`)
+    out = _row(prompt_ids[0])[None, :] if B == 1 \
+        else jax.vmap(_row)(prompt_ids)
     if eos_id is not None:
         # same contract as `generate`: positions after the first eos
         # become pad (the eos itself stays)

@@ -238,7 +238,16 @@ class LlamaAttention(nn.Module):
         blocks back into a contiguous [B, MB*bs] view and run the same
         masked grouped attention. Out-of-range or unmapped positions
         route to physical block 0 (the serve engine's null block), so
-        bucket padding can never corrupt a neighbour's blocks."""
+        bucket padding can never corrupt a neighbour's blocks.
+
+        With a [B] `cache_index` and T > 1 the call is a per-row
+        verify window: row b's T tokens occupy positions
+        cache_index[b]..cache_index[b]+T-1 under a per-row causal
+        mask. The speculative tick leans on this — it writes the k+1
+        window unconditionally and relies on rejected positions being
+        masked invisible (length not advanced) and idempotently
+        overwritten by the next window, so the KV cache never needs a
+        rollback."""
         c = self.cfg
         dense = _dense_ctor(c)
         q = dense(features=(c.n_heads, c.head_dim), name="q_proj")(x)

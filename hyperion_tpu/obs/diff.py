@@ -77,6 +77,14 @@ METRICS: dict[str, str] = {
     # alerts raised under the same seeded load is a direct "the SLO
     # got worse" signal — lower is better, zero is the healthy state
     "serve_alerts_raised": "lower",
+    # speculative decoding (serve/draft.py via the bench serving row's
+    # @spec dimension, k=4 point): acceptance falling means the draft
+    # stopped predicting the target, tokens-per-slot-tick falling
+    # means the speedup itself regressed — both gated alongside the
+    # TTFT keys above so speculation can never buy throughput by
+    # selling first-token latency unnoticed
+    "serve_accept_rate": "higher",
+    "serve_tokens_per_tick": "higher",
     # replica-tier scaling (serve/router.py via the bench serving_scale
     # row): aggregate throughput at N replicas, scaleup vs one replica,
     # dispatch fairness (min replica share x N; 1.0 = perfectly even),
@@ -159,7 +167,10 @@ def normalize(doc: dict) -> dict[str, float]:
                                "serve_client_write_p99_ms"),
                               ("shed_rate", "serve_shed_rate"),
                               ("clamp_rate", "serve_clamp_rate"),
-                              ("alerts_raised", "serve_alerts_raised")):
+                              ("alerts_raised", "serve_alerts_raised"),
+                              ("accept_rate", "serve_accept_rate"),
+                              ("tokens_per_tick",
+                               "serve_tokens_per_tick")):
                 v = _num(srv.get(src))
                 if v is not None:
                     out[name] = v

@@ -67,6 +67,10 @@ INPUT_BOUND_FRAC = 0.5
 # the p99 cohort's latency (obs/timeline.py) earns a NAMED incident —
 # below it, the tail is diffuse and naming one phase would mislead
 TAIL_DOMINANT_FRAC = 0.4
+# speculative-decoding acceptance floor: below this the k+1-wide verify
+# forward is mostly wasted work — the run pays spec overhead for
+# roughly sequential progress, so the draft config is a named incident
+SPEC_ACCEPT_FLOOR = 0.3
 
 
 def locate(target: str | Path) -> tuple[Path, Path]:
@@ -295,6 +299,12 @@ def diagnose(
                 # SLO burn-rate alerting (obs/slo.py)
                 "alerts_raised": c.get("serve_alerts_raised"),
                 "alerts_active": g.get("serve_alerts_active"),
+                # speculative decoding (serve/draft.py + engine spec tick)
+                "spec_drafted": c.get("serve_spec_drafted"),
+                "spec_accepted": c.get("serve_spec_accepted"),
+                "spec_rejected": c.get("serve_spec_rejected"),
+                "accept_rate": g.get("serve_spec_accept_rate"),
+                "tokens_per_tick": g.get("serve_tokens_per_tick"),
             }
 
     # ---- stall signal: tail steps vs the run's own earlier median ----
@@ -419,6 +429,22 @@ def diagnose(
     if cache_pressure and verdict in ("healthy", "running", "stalled",
                                       "failed"):
         reason += "; cache pressure: " + "; ".join(cache_pressure)
+
+    # Low-acceptance speculation incident (spec-enabled runs only): when
+    # drafts mostly miss, every decode tick still pays the k+1-wide
+    # verify forward but advances roughly one token — worse than plain
+    # sequential decode. That is a draft-config bug worth naming with
+    # the exact knobs to turn, not a number to eyeball in a gauge dump.
+    spec_issues: list[str] = []
+    if serve and serve.get("spec_drafted"):
+        rate = serve.get("accept_rate")
+        if rate is not None and rate < SPEC_ACCEPT_FLOOR:
+            spec_issues.append(
+                f"draft acceptance {rate:.2f} < {SPEC_ACCEPT_FLOOR}: "
+                "draft mispredicting — lower --spec-k or disable --draft")
+    if spec_issues and verdict in ("healthy", "running", "stalled",
+                                   "failed"):
+        reason += "; speculation: " + "; ".join(spec_issues)
 
     # Overload + crash-safety incidents (PR 8): shed/clamped requests
     # mean the brownout governor fired — the server DEGRADED instead of
@@ -605,6 +631,7 @@ def diagnose(
         "fleet": fleet_rows,
         "fleet_incidents": fleet_incidents,
         "cache_pressure": cache_pressure,
+        "spec_incidents": spec_issues,
         "overload": overload,
         "poisoned_requests": poisoned_ids,
         "tail_attribution": tail_rows,
@@ -728,6 +755,15 @@ def render_markdown(d: dict) -> str:
                 f"{_fmt(srv.get('prefix_hit_rate'))}, preempted "
                 f"{_fmt(srv.get('preempted'))}, HBM/req "
                 f"{_fmt(srv.get('hbm_per_req_mb'))} MB{flag} |")
+        if srv.get("spec_drafted"):
+            flag = " — **low acceptance**" if d.get("spec_incidents") else ""
+            lines.append(
+                f"| serve speculation | drafted "
+                f"{_fmt(srv.get('spec_drafted'))}, accepted "
+                f"{_fmt(srv.get('spec_accepted'))}, rejected "
+                f"{_fmt(srv.get('spec_rejected'))}, accept rate "
+                f"{_fmt(srv.get('accept_rate'))}, "
+                f"{_fmt(srv.get('tokens_per_tick'))} tokens/tick{flag} |")
     for row in d.get("slo_alerts") or []:
         flag = " — **FIRING**" if row.get("active") else " (cleared)"
         lines.append(

@@ -42,13 +42,24 @@ once, at warmup, forever:
     tokens ride along and re-prefill, usually from its own still-
     cached prefix) instead of crashing.
 
+  * Speculative decoding (`spec_k` + `serve/draft.py`) turns the tick
+    into a draft/verify/accept round: a host-side draft source
+    proposes up to k tokens per slot, ONE batched target forward over
+    a `[S, k+1]` window scores all slots' proposals through the same
+    paged path (vector `cache_index` + per-row position masks), and a
+    fully static accept-masked select emits the longest prefix the
+    target agrees with plus its own correction — 1..k+1 tokens per
+    slot per tick, one executable per (S, k), zero retraces.
+
 Semantics contract (the oracle `tests/test_serve.py` pins): at
 temperature 0 a request decoded through this engine — while other
-slots churn, share its blocks, or preempt around it — emits
-**bit-identical tokens** to `infer/generate.generate` on the same
-prompt. K/V at position p depend only on tokens 0..p, so shared blocks
-hold exactly the values each sharer would have computed, and every
-per-slot op is row-independent.
+slots churn, share its blocks, or preempt around it, with or without
+speculation — emits **bit-identical tokens** to
+`infer/generate.generate` on the same prompt. K/V at position p depend
+only on tokens 0..p, so shared blocks hold exactly the values each
+sharer would have computed, and every per-slot op is row-independent;
+the acceptance rule only ever keeps tokens the target itself would
+have produced.
 """
 
 from __future__ import annotations
@@ -64,6 +75,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from hyperion_tpu.infer.generate import sample_token_slots
+from hyperion_tpu.infer.speculative import accept_draft
+from hyperion_tpu.serve.draft import DraftSource, NgramDraft
 from hyperion_tpu.serve.blocks import (
     BlockManager,
     RadixPrefixCache,
@@ -127,6 +140,75 @@ def _tick_impl(model, eos_id, pad_id, variables, cache, st, bt, live):
     return cache, st, nxt, finished
 
 
+def _spec_tick_impl(model, eos_id, pad_id, variables, cache, st, bt, live,
+                    drafts):
+    # the speculative tick: every live slot advances 1..k+1 tokens in
+    # ONE target forward. The verify window [last_token, d_1..d_k]
+    # writes K/V at positions lengths..lengths+k through each slot's
+    # block-table row (the paged path takes a [S]-vector cache_index
+    # and spans T positions per row — models/llama.py), and row i's
+    # logits predict position lengths+i+1. Acceptance per slot is the
+    # shared longest-agreeing-prefix rule (infer/speculative.py), so
+    # temp-0 output is bit-identical to sequential decode; rejected
+    # positions hold stale K/V that the causal mask keeps invisible
+    # until the next window idempotently overwrites them. Every update
+    # below is an accept-MASKED select over static [S, k+1] shapes —
+    # never a dynamic slice — so one executable serves every
+    # acceptance pattern and `compile_stats()` stays flat.
+    act = st["active"] & live
+    k = drafts.shape[1]
+    window = jnp.concatenate([st["last_token"][:, None], drafts], axis=1)
+    logits, cache = model.apply(
+        variables, window,
+        cache=cache, cache_index=st["lengths"], block_tables=bt,
+    )
+    # t[s, i] = the token the SEQUENTIAL tick would emit at position
+    # lengths[s]+i given this window prefix: greedy rows take argmax;
+    # temp>0 rows draw with the slot key folded at that position —
+    # the exact fold the sequential tick performs — so a seeded
+    # sampling stream is unchanged whether its drafts hit or miss
+    pos = st["lengths"][:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    keys = jax.vmap(jax.vmap(jax.random.fold_in, in_axes=(None, 0)))(
+        st["keys"], pos)
+    t_arr = jax.vmap(
+        lambda lg, ky: sample_token_slots(
+            lg, ky, st["temperature"], st["top_k"], st["top_p"]),
+        in_axes=1, out_axes=1,
+    )(logits, keys)  # [S, k+1]
+    m, v = accept_draft(drafts, t_arr)
+    # emit v[:, j] iff j is within the accepted prefix (+correction),
+    # within the remaining budget, and no earlier eos in the window —
+    # active rows always emit >= 1 (j=0 is the correction of an empty
+    # prefix and budget >= 1 while active), matching the sequential
+    # tick's liveness
+    iota = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    is_eos = (v == eos_id) if eos_id is not None \
+        else jnp.zeros(v.shape, bool)
+    eos_before = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) \
+        - is_eos.astype(jnp.int32)
+    remaining = st["budget"] - st["generated"]
+    emit = (iota <= m[:, None]) & (iota < remaining[:, None]) \
+        & (eos_before == 0) & act[:, None]
+    cnt = emit.sum(axis=1).astype(jnp.int32)
+    out = jnp.where(emit, v, jnp.int32(pad_id))
+    last_i = jnp.maximum(cnt - 1, 0)[:, None]
+    last = jnp.take_along_axis(v, last_i, axis=1)[:, 0]
+    ended_eos = jnp.take_along_axis(is_eos, last_i, axis=1)[:, 0] & (cnt > 0)
+    gen = st["generated"] + cnt
+    finished = act & (ended_eos | (gen >= st["budget"]))
+    st = {
+        **st,
+        "last_token": jnp.where(act & (cnt > 0), last, st["last_token"]),
+        "generated": gen,
+        "lengths": st["lengths"] + cnt,
+        "active": act & ~finished,
+    }
+    # accepted DRAFTS only (the correction token is a normal decode
+    # token, not a draft win) — what the acceptance-rate gauge reads
+    acc = jnp.minimum(m, cnt)
+    return cache, st, out, cnt, acc, finished
+
+
 def _prefill_impl(model, eos_id, variables, cache, st, prompt, bt_row,
                   slot, start, true_len, temperature, top_k, top_p,
                   budget, key):
@@ -181,10 +263,12 @@ _SHARED_JITS: dict[bool, tuple] = {}
 
 
 def _shared_jits(donate: bool) -> tuple:
-    """(tick, prefill, copy) jit objects, one set per donation mode.
-    Donation keeps the pool + state slabs in place on real chips; the
-    CPU backend ignores donation with a warning, so callers pass
-    donate=False there."""
+    """(tick, prefill, copy, spec_tick) jit objects, one set per
+    donation mode. Donation keeps the pool + state slabs in place on
+    real chips; the CPU backend ignores donation with a warning, so
+    callers pass donate=False there. The spec tick specializes on the
+    drafts array's [S, k] shape, so one executable serves a given
+    (slots, k) forever — k is a config constant, never a retrace."""
     if donate not in _SHARED_JITS:
         _SHARED_JITS[donate] = (
             jax.jit(_tick_impl, static_argnums=(0, 1, 2),
@@ -193,6 +277,8 @@ def _shared_jits(donate: bool) -> tuple:
                     donate_argnums=(3, 4) if donate else ()),
             jax.jit(_copy_impl,
                     donate_argnums=(0,) if donate else ()),
+            jax.jit(_spec_tick_impl, static_argnums=(0, 1, 2),
+                    donate_argnums=(4, 5) if donate else ()),
         )
     return _SHARED_JITS[donate]
 
@@ -219,6 +305,13 @@ class EngineConfig:
     # when the pool runs dry (vLLM's default posture; higher occupancy,
     # tail-latency risk under pathological growth).
     admission: str = "reserve"
+    # ---- speculative decoding (serve/draft.py) ----
+    # spec_k > 0 with a draft source turns each decode tick into a
+    # draft/verify/accept round emitting 1..spec_k+1 tokens per slot;
+    # temp-0 output stays bit-identical to sequential decode (the
+    # accept rule only keeps tokens the target would have produced)
+    spec_k: int = 0                # draft tokens per slot per tick (0 = off)
+    draft: str = "off"             # "ngram" (self-drafting) | "off"
     # ---- overload brownout (serve/queue.py:BrownoutGovernor) ----
     brownout: bool = False         # enable the governor
     brownout_depth: int = 0        # enter watermark (0 = 3/4 of capacity)
@@ -281,6 +374,16 @@ class Engine:
         if cfg.admission not in ("reserve", "optimistic"):
             raise ValueError(f"admission must be 'reserve' or 'optimistic', "
                              f"got {cfg.admission!r}")
+        if cfg.draft not in ("off", "ngram"):
+            raise ValueError(
+                f"draft must be 'off' or 'ngram', got {cfg.draft!r}")
+        if cfg.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {cfg.spec_k}")
+        # speculation needs both a window (spec_k) and a proposer
+        # (draft): either alone leaves the sequential tick in charge
+        self._spec = cfg.spec_k > 0 and cfg.draft != "off"
+        self._drafter: DraftSource | None = \
+            NgramDraft() if self._spec else None
         bs = cfg.block_size
         self._mb = blocks_for(L, bs)          # block-table width per slot
         num_blocks = cfg.num_blocks or cfg.slots * self._mb + 1
@@ -342,7 +445,8 @@ class Engine:
         # write in the window, not just the request's own — a slow
         # neighbour's client must not read as this slot's decode time
         self._sink_s = 0.0
-        self._tick_jit, self._prefill_jit, self._copy_jit = _shared_jits(
+        (self._tick_jit, self._prefill_jit, self._copy_jit,
+         self._spec_jit) = _shared_jits(
             donate=jax.default_backend() != "cpu")
 
     # ------------------------------------------------------ device state
@@ -384,6 +488,7 @@ class Engine:
             "tick_executables": self._tick_jit._cache_size(),
             "prefill_executables": self._prefill_jit._cache_size(),
             "copy_executables": self._copy_jit._cache_size(),
+            "spec_tick_executables": self._spec_jit._cache_size(),
         }
 
     def warmup(self, prompt_lens: list[int] | None = None) -> dict:
@@ -419,6 +524,12 @@ class Engine:
                 # land in the garbage block, real state is untouched
                 self._prefill_call(dummy, slot=0, bucket_len=pb)
             _ = self._tick_device()
+            if self._spec:
+                # the spec tick's one executable for this (S, k) —
+                # all-zero drafts exercise the same shapes live
+                # traffic will (acceptance is data, not shape)
+                _ = self._spec_tick_device(
+                    np.zeros((self.cfg.slots, self.cfg.spec_k), np.int32))
             zero = jnp.zeros((1,), jnp.int32)
             self._cache = self._copy_jit(self._cache, zero, zero)
             sp.set(buckets=lens)
@@ -466,6 +577,31 @@ class Engine:
             self.variables, self._cache, self._state, *self._bt_dev)
         # the host fetch is the fence: tick spans time real work
         return np.asarray(toks), np.asarray(fins)
+
+    def _collect_drafts(self) -> np.ndarray:
+        """[S, spec_k] proposals for this tick, one drafter call per
+        live slot over its visible context — host-side only, shipped
+        with the tick like the block table. Dead lanes stay zero (the
+        tick masks them out anyway)."""
+        k = self.cfg.spec_k
+        drafts = np.zeros((self.cfg.slots, k), np.int32)
+        for s, req in enumerate(self._slots):
+            if req is not None:
+                drafts[s] = self._drafter.propose(
+                    s, req.prompt_ids, req.tokens, k)
+        return drafts
+
+    def _spec_tick_device(self, drafts: np.ndarray):
+        if self._bt_dev is None:
+            live = np.fromiter((r is not None for r in self._slots),
+                               bool, len(self._slots))
+            self._bt_dev = (jnp.asarray(self._bt), jnp.asarray(live))
+        self._cache, self._state, out, cnt, acc, fins = self._spec_jit(
+            self.model, self.cfg.eos_id, self.cfg.pad_id,
+            self.variables, self._cache, self._state, *self._bt_dev,
+            jnp.asarray(drafts))
+        return (np.asarray(out), np.asarray(cnt), np.asarray(acc),
+                np.asarray(fins))
 
     # --------------------------------------------------- block plumbing
 
@@ -687,7 +823,21 @@ class Engine:
         ):
             while self._slots[s] is not None:
                 seq = self._seqs[s]
-                needed = seq.n_filled // self.cfg.block_size + 1
+                lookahead = 0
+                if self._spec:
+                    # the verify window writes positions n_filled ..
+                    # n_filled+k, but only positions an ACCEPTED token
+                    # can land in need real blocks (acceptance is
+                    # capped by the remaining budget; writes past the
+                    # table's chain null-route harmlessly) — so the
+                    # lookahead never exceeds the worst-case span the
+                    # reserve-mode ledger already accounts for
+                    req = self._slots[s]
+                    lookahead = max(0, min(
+                        self.cfg.spec_k,
+                        req.max_new_tokens - len(req.tokens) - 1))
+                needed = (seq.n_filled + lookahead) \
+                    // self.cfg.block_size + 1
                 if len(seq.blocks) >= needed:
                     break
                 got = self._alloc(1, seq)
@@ -1018,7 +1168,8 @@ class Engine:
         """One scheduling round: admit from the queue into free slots
         (block-gated, prefill, budget-limited), ensure every live slot
         owns its next write block (preempting on exhaustion), advance
-        all active slots one token, route emissions."""
+        all active slots — one token each, or 1..spec_k+1 under the
+        speculative tick — and route emissions."""
         emissions: list[TokenEvent] = []
         now = time.monotonic()
 
@@ -1121,37 +1272,61 @@ class Engine:
         if self.n_active:
             if self.chaos is not None:
                 self.chaos.on_tick(self._tick_no)
+            spec = self._spec
+            cnts = accs = None
+            drafts = self._collect_drafts() if spec else None
             with self.tracer.span("serve_tick", step=self._tick_no) as sp:
                 t0 = time.monotonic()
-                toks, fins = self._tick_device()
+                if spec:
+                    toks, cnts, accs, fins = self._spec_tick_device(drafts)
+                else:
+                    toks, fins = self._tick_device()
                 dur = time.monotonic() - t0
                 sp.set(active=self.n_active)
             emitted = 0
+            slot_ticks = 0
             tnow = time.monotonic()
             for s, req in enumerate(self._slots):
                 if req is None:
                     continue
-                self._seqs[s].n_filled += 1
-                ev = TokenEvent(req, int(toks[s]), bool(fins[s]))
+                slot_ticks += 1
+                n = int(cnts[s]) if spec else 1
+                if spec:
+                    self.metrics.on_spec(self.cfg.spec_k, int(accs[s]))
+                if n == 0:
+                    continue
+                self._seqs[s].n_filled += n
                 gap_from = getattr(req, "_last_emit_at", None)
                 if gap_from is not None:
-                    self.metrics.on_token_gap(tnow - gap_from)
                     # the gap is wall time shared by every slot: net it
                     # of ALL sink writes since this request's previous
                     # emission (its own are charged to client_write;
-                    # neighbours' must not masquerade as decode)
+                    # neighbours' must not masquerade as decode). One
+                    # verify pass produced n tokens, so TPOT charges
+                    # the pass pro-rata across them — the per-token
+                    # cadence a streaming client actually experiences
+                    for _ in range(n):
+                        self.metrics.on_token_gap((tnow - gap_from) / n)
                     sink = self._sink_s - getattr(
                         req, "_sink_mark", self._sink_s)
                     req.decode_s += max(0.0, tnow - gap_from - sink)
                 req._last_emit_at = tnow
                 req._sink_mark = self._sink_s
-                self._emit(ev)
-                emissions.append(ev)
-                emitted += 1
-                if ev.finished:
+                fin_slot = bool(fins[s])
+                # every accepted token flows through the SAME per-token
+                # path the sequential tick uses: one journal `tok`
+                # record, one stream index, one sink write apiece —
+                # failover dedup and replay never see speculation
+                for j in range(n):
+                    tok = int(toks[s, j]) if spec else int(toks[s])
+                    ev = TokenEvent(req, tok, fin_slot and j == n - 1)
+                    self._emit(ev)
+                    emissions.append(ev)
+                    emitted += 1
+                if fin_slot:
                     self._on_finished(req)
                     self._free_slot(s)
-            self.metrics.on_tick(dur, emitted)
+            self.metrics.on_tick(dur, emitted, slot_ticks)
             self._tick_no += 1
             if self.cfg.snapshot_every \
                     and self._tick_no % self.cfg.snapshot_every == 0:

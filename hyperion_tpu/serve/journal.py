@@ -15,6 +15,12 @@ restart needs to finish the job:
     {"k":"poisoned","id":...,"n":K}     quarantined by the poison rule
     {"k":"close"}                       clean shutdown — replay nothing
 
+A speculative decode tick (``--spec-k``) can accept several tokens in
+one engine step; each still lands as its own `tok` record, in emission
+order, before its sink write — the format and the ordering contract
+below are tick-shape agnostic, so recovery neither knows nor cares
+whether a token came from a sequential or a multi-token tick.
+
 Recovery (`recover()`) replays the file: a request with an `admit` but
 no terminal record is *pending* — it is handed back to the engine with
 its already-emitted tokens riding along, and resumes through the same

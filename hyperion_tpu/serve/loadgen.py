@@ -183,6 +183,18 @@ def run_load(engine, spec: LoadSpec) -> dict:
            for k in ("prefix_hit_rate", "prefill_tokens_saved",
                      "preempted", "cow_copies", "blocks_in_use",
                      "hbm_per_req_mb")},
+        # speculative decoding (serve/draft.py): acceptance quality +
+        # effective per-slot advance — `obs diff` gates both as
+        # higher-is-better on spec-enabled rows (accept_rate is None
+        # on a spec-off run, which diff treats as "not measured")
+        "accept_rate": (round(cache["accept_rate"], 4)
+                        if cache.get("accept_rate") is not None else None),
+        "tokens_per_tick": (round(cache["tokens_per_tick"], 4)
+                            if cache.get("tokens_per_tick") is not None
+                            else None),
+        "spec_drafted": cache.get("spec_drafted", 0),
+        "spec_accepted": cache.get("spec_accepted", 0),
+        "spec_rejected": cache.get("spec_rejected", 0),
         # overload brownout (PR 8): shed/clamp events as rates so
         # `obs diff` gates them across rounds at any request count
         "shed": cache.get("shed", 0),

@@ -52,8 +52,16 @@ class ServeMetrics:
                      "serve_journal_errors", "serve_dropped_sinks",
                      # SLO burn-rate alerting (obs/slo.py): a run that
                      # never alerted must snapshot raised=0, not omit it
-                     "serve_alerts_raised", "serve_alerts_cleared"):
+                     "serve_alerts_raised", "serve_alerts_cleared",
+                     # speculative decoding (serve/draft.py + the
+                     # engine's spec tick): drafted = accepted+rejected
+                     "serve_spec_drafted", "serve_spec_accepted",
+                     "serve_spec_rejected"):
             self.reg.counter(name)
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._tick_tokens = 0
+        self._ticks = 0
         # 0/1 flag, pre-set so "never browned out" snapshots as 0
         self.reg.gauge("serve_brownout_active").set(0.0)
         self.reg.gauge("serve_alerts_active").set(0.0)
@@ -180,10 +188,37 @@ class ServeMetrics:
             self._tokens += n
             self.reg.counter("tokens").inc(n)
 
-    def on_tick(self, dur_s: float, tokens_emitted: int) -> None:
+    def on_tick(self, dur_s: float, tokens_emitted: int,
+                slot_ticks: int | None = None) -> None:
         self.reg.counter("serve_ticks").inc()
         self.reg.histogram("serve_tick_ms").observe(dur_s * 1e3)
         self.count_tokens(tokens_emitted)
+        # effective tokens per SLOT-tick (one live slot in one tick):
+        # decode emissions over slot-ticks, prefill firsts excluded.
+        # The sequential tick's ceiling is exactly 1.0 — anything
+        # above is speculation actually landing, which is why the
+        # bench/diff gate reads this gauge and not raw throughput
+        self._ticks += slot_ticks if slot_ticks is not None \
+            else tokens_emitted
+        self._tick_tokens += tokens_emitted
+        if self._ticks:
+            self.reg.gauge("serve_tokens_per_tick").set(
+                self._tick_tokens / self._ticks)
+
+    def on_spec(self, drafted: int, accepted: int) -> None:
+        """One slot's verify outcome this tick: `drafted` proposals
+        entered the window, `accepted` survived the longest-prefix
+        rule. The correction token is NOT counted — it's a normal
+        decode token the sequential tick would also have produced,
+        so accept_rate measures pure draft quality."""
+        self._spec_drafted += drafted
+        self._spec_accepted += accepted
+        self.reg.counter("serve_spec_drafted").inc(drafted)
+        self.reg.counter("serve_spec_accepted").inc(accepted)
+        self.reg.counter("serve_spec_rejected").inc(drafted - accepted)
+        if self._spec_drafted:
+            self.reg.gauge("serve_spec_accept_rate").set(
+                self._spec_accepted / self._spec_drafted)
 
     def observe_state(self, queue_depth: int, slots_active: int,
                       n_slots: int) -> None:
@@ -251,6 +286,14 @@ class ServeMetrics:
             "alerts_raised": int(c.get("serve_alerts_raised", 0)),
             "alerts_cleared": int(c.get("serve_alerts_cleared", 0)),
             "alerts_active": int(g.get("serve_alerts_active") or 0),
+            # speculative decoding (serve/draft.py + the spec tick):
+            # accept_rate is None on a spec-disabled run (nothing was
+            # ever drafted), never a misleading 0.0
+            "spec_drafted": int(c.get("serve_spec_drafted", 0)),
+            "spec_accepted": int(c.get("serve_spec_accepted", 0)),
+            "spec_rejected": int(c.get("serve_spec_rejected", 0)),
+            "accept_rate": g.get("serve_spec_accept_rate"),
+            "tokens_per_tick": g.get("serve_tokens_per_tick"),
         }
 
 

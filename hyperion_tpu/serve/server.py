@@ -358,6 +358,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "in-flight decode ticks")
     p.add_argument("--max-new-default", type=int, default=32,
                    help="max_new_tokens when a request omits it")
+    # ---- speculative decoding (serve/draft.py) ----
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="speculative decoding: draft tokens verified "
+                        "per slot per tick (0 = off). Each tick then "
+                        "emits 1..k+1 tokens per slot for ONE target "
+                        "forward; temp-0 output is bit-identical to "
+                        "sequential decode, so this is pure speed. "
+                        "Needs --draft; lower it (or disable) if "
+                        "`obs doctor` reports draft misprediction")
+    p.add_argument("--draft", choices=("ngram", "off"), default="off",
+                   help="draft source for --spec-k: 'ngram' = "
+                        "self-drafting suffix lookup over each slot's "
+                        "prompt + generated tokens (no second "
+                        "checkpoint); 'off' disables speculation")
     p.add_argument("--eos-id", type=int, default=None,
                    help="override the eos token id (default: the "
                         "tokenizer's)")
@@ -611,6 +625,7 @@ def main(argv=None) -> int:
             prefill_budget=args.prefill_budget,
             block_size=args.block_size, num_blocks=args.num_blocks,
             prefix_cache=args.prefix_cache,
+            spec_k=args.spec_k, draft=args.draft,
             brownout=args.brownout,
             brownout_depth=args.brownout_depth,
             brownout_wait_s=args.brownout_wait_s,

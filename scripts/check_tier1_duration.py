@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Tier-1 wall-time guard.
+
+Tier-1 must finish inside its 870s timeout with headroom — a suite
+that creeps past ~850s is one slow test away from the timeout killing
+the run mid-suite, which reads as a mass failure instead of the real
+regression. This guard parses the pytest summary line out of the
+tier-1 log (`tee /tmp/_t1.log` in the ROADMAP verify command, run
+with `--durations=15` so the log also names the offenders) and fails
+when the suite's own reported wall time exceeds the budget.
+
+    python scripts/check_tier1_duration.py /tmp/_t1.log [budget_s] \
+        [--elapsed SECONDS]
+
+Quiet runs need `--elapsed`: the pyproject addopts already carry `-q`,
+so the ROADMAP command's own `-q` stacks to `-qq`, which suppresses
+the final summary line entirely. The verify command therefore records
+its own wall clock (`t0=$(date +%s)` ... `--elapsed $(($(date +%s)-t0))`)
+and the guard falls back to that measurement when no summary parses.
+
+Exit 0: under budget. Exit 1: over budget, or neither a summary line
+nor `--elapsed` available (no summary and no measurement means pytest
+never finished — also a failure).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+DEFAULT_BUDGET_S = 850.0
+
+# pytest's final summary: "=== 1014 passed, 3 skipped in 782.41s (0:13:02) ==="
+_SUMMARY = re.compile(r"^=+ .*\bin (\d+(?:\.\d+)?)s(?: \([0-9:]+\))? =+")
+
+
+def tier1_wall_s(log_text: str) -> float | None:
+    last = None
+    for line in log_text.splitlines():
+        m = _SUMMARY.match(line.strip())
+        if m:
+            last = float(m.group(1))
+    return last
+
+
+def main(argv: list[str]) -> int:
+    elapsed = None
+    rest = []
+    it = iter(argv)
+    for a in it:
+        if a == "--elapsed":
+            nxt = next(it, None)
+            if nxt is None:
+                print("tier1-duration: --elapsed needs a value",
+                      file=sys.stderr)
+                return 2
+            elapsed = float(nxt)
+        else:
+            rest.append(a)
+    if not rest:
+        print("usage: check_tier1_duration.py <tier1.log> [budget_s] "
+              "[--elapsed SECONDS]", file=sys.stderr)
+        return 2
+    budget = float(rest[1]) if len(rest) > 1 else DEFAULT_BUDGET_S
+    try:
+        text = open(rest[0], errors="replace").read()
+    except OSError as e:
+        print(f"tier1-duration: cannot read {rest[0]}: {e}",
+              file=sys.stderr)
+        return 1
+    wall = tier1_wall_s(text)
+    source = "pytest summary"
+    if wall is None:
+        wall = elapsed
+        source = "measured elapsed"
+    if wall is None:
+        print(f"tier1-duration: no pytest summary line in {rest[0]} and "
+              "no --elapsed measurement — the suite never finished "
+              "(timeout?)", file=sys.stderr)
+        return 1
+    if wall > budget:
+        print(f"tier1-duration: FAIL — suite took {wall:.0f}s "
+              f"({source}; > {budget:.0f}s budget); see the "
+              "--durations=15 table in the log for the slowest tests",
+              file=sys.stderr)
+        return 1
+    print(f"tier1-duration: OK — {wall:.0f}s of {budget:.0f}s budget "
+          f"({source})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

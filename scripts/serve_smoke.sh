@@ -380,3 +380,42 @@ PY
 kill -TERM "$ROUTE_PID" 2>/dev/null || true
 wait "$ROUTE_PID" || true
 trap - EXIT
+
+# 9. speculative round trip: the SAME request leg 6 decoded
+#    sequentially (ref_responses.jsonl) now runs with the n-gram
+#    self-draft verifying 4 tokens per tick — the stream must be
+#    bit-identical (the accept rule is exact at temperature 0), and
+#    the telemetry stream must show the draft/verify loop actually ran
+printf '%s\n' "$KILLREQ" \
+  | env HYPERION_TELEMETRY="$WORK/spec_tele.jsonl" \
+    python -m hyperion_tpu.cli.main serve \
+      --ckpt "$WORK/llama.npz" --no-tokenizer \
+      --max-len 64 --slots 2 --warmup-lens 8,32 \
+      --spec-k 4 --draft ngram \
+      > "$WORK/spec_responses.jsonl"
+
+python - "$WORK/ref_responses.jsonl" "$WORK/spec_responses.jsonl" \
+         "$WORK/spec_tele.jsonl" <<'PY'
+import json
+import sys
+
+
+def stream(path):
+    return [rec["token"] for rec in map(json.loads, open(path))
+            if rec.get("id") == "k1" and rec.get("event") == "token"
+            and rec.get("token") is not None]
+
+
+ref, got = stream(sys.argv[1]), stream(sys.argv[2])
+assert len(ref) == 10 and got == ref, (
+    f"speculative stream diverges from sequential: {got} != {ref}")
+drafted = 0
+for line in open(sys.argv[3]):
+    rec = json.loads(line)
+    if rec.get("kind") == "snapshot":
+        c = rec.get("metrics", {}).get("counters", {})
+        drafted = max(drafted, c.get("serve_spec_drafted", 0))
+assert drafted > 0, "spec run never drafted — did --spec-k reach the engine?"
+print(f"[serve_smoke] OK: speculative round trip — {len(got)} tokens "
+      f"bit-identical to the sequential run ({drafted} drafted)")
+PY

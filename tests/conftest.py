@@ -9,16 +9,32 @@ the platform is overridden via jax.config, not env vars.
 """
 
 import os
+import tempfile
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 os.environ.setdefault("HF_HUB_OFFLINE", "1")
 os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
+# Persistent XLA compile cache, shared with the subprocess CLI tests
+# (supervisor/serve spawn `python -m hyperion_tpu.cli.main ...`, which
+# inherits this env): the trainer re-jits an identical step function
+# per call, and without the cache each integration test pays the same
+# ~35s XLA compile again. Content-keyed, so correctness is unaffected;
+# compile-count assertions count traces, not XLA wall time.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "hyperion_tpu_xla_cache"),
+)
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# sitecustomize may have imported jax before the env var landed; the
+# runtime config update covers the in-process half either way
+jax.config.update(
+    "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+)
 
 import pytest  # noqa: E402
 

@@ -286,3 +286,58 @@ class TestAttentionBench:
         assert "Long-seq attention" in out
         assert "oom" in out and "12.5" in out  # xla OOM row renders as such
         assert "nanx" not in out  # no speedup computed from a nan row
+
+
+class TestTier1DurationGuard:
+    """scripts/check_tier1_duration.py — the tier-1 wall-time budget
+    (a suite one slow test away from the 870s timeout is already a
+    regression; the guard fails it at 850s with headroom to spare)."""
+
+    def _guard(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "check_tier1_duration",
+            Path(__file__).parent.parent / "scripts"
+            / "check_tier1_duration.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_under_budget_passes(self, tmp_path):
+        mod = self._guard()
+        log = tmp_path / "t1.log"
+        log.write_text("...\n== 1014 passed, 3 skipped in 782.41s "
+                       "(0:13:02) ==\n")
+        assert mod.main([str(log)]) == 0
+
+    def test_over_budget_fails(self, tmp_path):
+        mod = self._guard()
+        log = tmp_path / "t1.log"
+        log.write_text("== 1014 passed in 861.02s (0:14:21) ==\n")
+        assert mod.main([str(log)]) == 1
+        # and a custom budget is respected
+        assert mod.main([str(log), "900"]) == 0
+
+    def test_missing_summary_is_a_failure(self, tmp_path):
+        # a log with no summary line means pytest never finished —
+        # exactly the timeout scenario the guard exists to preempt
+        mod = self._guard()
+        log = tmp_path / "t1.log"
+        log.write_text("tests/test_serve.py ......\n")
+        assert mod.main([str(log)]) == 1
+        assert mod.main([str(tmp_path / "missing.log")]) == 1
+
+    def test_elapsed_fallback_when_quiet_log_has_no_summary(self, tmp_path):
+        # the real tier-1 command runs at -qq (pyproject -q + command
+        # -q), which suppresses the summary line entirely: the guard
+        # must then judge the shell-measured elapsed time instead
+        mod = self._guard()
+        log = tmp_path / "t1.log"
+        log.write_text(".......... [100%]\n")
+        assert mod.main([str(log), "--elapsed", "790"]) == 0
+        assert mod.main([str(log), "--elapsed", "863"]) == 1
+        # a parsed summary line wins over the measurement (the shell
+        # clock includes collection + teardown slop)
+        log.write_text("== 1014 passed in 700.00s (0:11:40) ==\n")
+        assert mod.main([str(log), "--elapsed", "9999"]) == 0

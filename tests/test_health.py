@@ -264,9 +264,9 @@ def _train_cfg(tmp_path, **over):
 
     cfg = Config()
     cfg.train.epochs = 1
-    cfg.train.batch_size = 16
+    cfg.train.batch_size = 8
     cfg.train.seq_len = 16
-    cfg.train.steps_per_epoch = 6
+    cfg.train.steps_per_epoch = 2
     cfg.train.base_dir = str(tmp_path)
     cfg.train.validate = False
     cfg.train.learning_rate = 1e-2
@@ -320,7 +320,7 @@ class TestTrainerIntegration:
             return real_fence(tree)
 
         monkeypatch.setattr(trainer_mod, "host_fence", counting_fence)
-        cfg = _train_cfg(tmp_path, steps_per_epoch=4)
+        cfg = _train_cfg(tmp_path, steps_per_epoch=3)
         res = trainer_mod.train_language_model(cfg)
         assert len(res.history) == 1
         assert math.isfinite(res.final_loss)

@@ -236,6 +236,66 @@ class TestInputBound:
         assert "input wait" not in doctor.render_markdown(d)
 
 
+def write_spec_serve_run(path, run: str, drafted: int, accepted: int,
+                         tokens_per_tick: float = 1.4):
+    """A finished serve-shaped run whose last snapshot carries the
+    speculative-decoding counters/gauges (serve/metrics.py `on_spec`)."""
+    clk, wall = FakeClock(100.0), FakeClock(1_000.0)
+    t = Tracer(path, run=run, proc=0, clock=clk, wall=wall)
+    t.event("serve_start")
+    reg = MetricsRegistry()
+    reg.counter("serve_ticks").inc(50)
+    reg.counter("serve_completed").inc(4)
+    reg.counter("serve_spec_drafted").inc(drafted)
+    reg.counter("serve_spec_accepted").inc(accepted)
+    reg.counter("serve_spec_rejected").inc(drafted - accepted)
+    if drafted:
+        reg.gauge("serve_spec_accept_rate").set(accepted / drafted)
+    reg.gauge("serve_tokens_per_tick").set(tokens_per_tick)
+    reg.gauge("queue_depth").set(0.0)
+    t.snapshot(reg, step=50)
+    t.event("serve_end")
+    t.close()
+
+
+class TestSpeculationIncident:
+    """`obs doctor` on a spec-enabled serve run: the accept rate is an
+    incident below SPEC_ACCEPT_FLOOR (the k+1-wide verify forward is
+    then mostly wasted), with the knobs to turn named in the reason."""
+
+    def test_low_acceptance_is_named(self, tmp_path):
+        write_spec_serve_run(tmp_path / "telemetry.jsonl", "r1",
+                             drafted=400, accepted=60,
+                             tokens_per_tick=1.05)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["verdict"] == "healthy"
+        assert d["serve"]["spec_drafted"] == 400
+        assert d["spec_incidents"], "low acceptance produced no incident"
+        assert ("draft mispredicting — lower --spec-k or disable "
+                "--draft") in d["reason"]
+        md = doctor.render_markdown(d)
+        assert "serve speculation" in md
+        assert "**low acceptance**" in md
+
+    def test_healthy_acceptance_stays_quiet(self, tmp_path):
+        write_spec_serve_run(tmp_path / "telemetry.jsonl", "r1",
+                             drafted=400, accepted=240)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["spec_incidents"] == []
+        assert "mispredicting" not in d["reason"]
+        # the evidence row still renders, unflagged
+        md = doctor.render_markdown(d)
+        assert "serve speculation" in md
+        assert "low acceptance" not in md
+
+    def test_spec_off_run_has_no_row(self, tmp_path):
+        write_spec_serve_run(tmp_path / "telemetry.jsonl", "r1",
+                             drafted=0, accepted=0)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["spec_incidents"] == []
+        assert "serve speculation" not in doctor.render_markdown(d)
+
+
 # -------------------------------------------------- telemetry contract
 
 

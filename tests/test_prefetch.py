@@ -118,9 +118,9 @@ class TestTrainerOverlapE2E:
 
         cfg = Config()
         cfg.train.epochs = 2
-        cfg.train.batch_size = 16
+        cfg.train.batch_size = 8
         cfg.train.seq_len = 16
-        cfg.train.steps_per_epoch = 3
+        cfg.train.steps_per_epoch = 2
         cfg.train.learning_rate = 1e-3
         cfg.train.validate = False
         cfg.train.telemetry = telemetry
@@ -153,7 +153,7 @@ class TestTrainerOverlapE2E:
 
         job_dir = tmp_path / "pre" / "checkpoints" / "language_ddp_8dev"
         step = ckpt.latest_step(job_dir)
-        assert step == 6  # 2 epochs x 3 steps
+        assert step == 4  # 2 epochs x 2 steps
         assert integrity.verify(job_dir / f"step_{step:08d}")[0]
 
         # telemetry acceptance: input_wait_s gauge + the span pair

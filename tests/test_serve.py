@@ -889,6 +889,9 @@ class TestJsonlServer:
             parsed.append(args)
         # the prefix round trip really exercises the paged knobs
         assert any(a.block_size != 16 and a.prefix_cache for a in parsed)
+        # and the speculative round trip really turns speculation on
+        assert any(a.spec_k > 0 and a.draft == "ngram" for a in parsed), (
+            "serve_smoke.sh lost the speculative round trip")
 
 
 # -------------------------------------------------------- load + soak
@@ -1190,6 +1193,105 @@ class TestCrashReplay:
         # and the quarantine is durable: the next recovery skips it too
         resume, _, poisoned, _ = RequestJournal(jp).recover()
         assert resume == [] and poisoned == []
+
+
+class TestSpeculative:
+    """The PR-12 tentpole oracle: speculative decode (spec_k=4, n-gram
+    self-draft) inside the engine stays bit-identical to `generate`
+    under the WORST combination the serving layer offers — 12-request
+    churn through an undersized optimistically-admitted pool (pool-
+    exhaustion preemption) crossed with a mid-stream crash and journal
+    replay — while the jit caches stay flat after warmup. Geometry
+    reuses the paged-churn test's shapes (slots 3, max_len 48,
+    block_size 8, num_blocks 8) so the only compile this class may add
+    to tier-1 is the single [3, 4] spec-tick executable."""
+
+    def _spec_engine(self, llama):
+        return _engine(llama, slots=3, block_size=8, num_blocks=8,
+                       admission="optimistic", queue_capacity=16,
+                       spec_k=4, draft="ngram")
+
+    def test_spec_oracle_churn_preemption_crash_replay(
+            self, tmp_path, llama):
+        from hyperion_tpu.serve.journal import RequestJournal
+
+        model, variables = llama
+        jp = tmp_path / "journal.jsonl"
+        eng1 = self._spec_engine(llama)
+        eng1.journal = RequestJournal(jp)
+        before = eng1.compile_stats()
+        stats0 = eng1.warmup()
+        # the spec tick is ONE new executable; everything else reuses
+        # the suite's already-warmed shapes (shared process-wide jits)
+        assert stats0["spec_tick_executables"] \
+            - before["spec_tick_executables"] == 1
+
+        rng = np.random.default_rng(35)
+        shared = rng.integers(1, 250, 16).astype(np.int32)
+        s1: list = []
+        reqs = []
+        for i in range(12):
+            if i % 3 == 0:    # shared-prefix family (drafts + hits)
+                ids = np.concatenate(
+                    [shared, rng.integers(1, 250, 2 + i % 5)])
+            elif i % 3 == 1:  # mid-block divergent family (COW)
+                ids = np.concatenate(
+                    [shared[:12], rng.integers(1, 250, 4 + i % 5)])
+            else:             # growers (preemption pressure)
+                ids = rng.integers(1, 250, 6)
+            reqs.append(Request(prompt_ids=ids.astype(np.int32),
+                                max_new_tokens=6 + (i % 4) * 4,
+                                id=f"spec{i}", sink=s1.append))
+        for r in reqs:
+            ok, reason = eng1.submit(r)
+            assert ok, reason
+        for _ in range(5):
+            eng1.step()  # mid-stream: tokens already delivered
+        # eng1 is abandoned here — nothing drained, closed, or flushed
+        # beyond the journal's own per-token appends
+
+        eng2 = self._spec_engine(llama)
+        eng2.journal = RequestJournal(jp)
+        stats1 = eng2.warmup()
+        assert stats1 == stats0, "second life recompiled something"
+        s2: list = []
+        info = eng2.replay_pending(s2.append)
+        assert info["poisoned"] == 0
+        _drain(eng2)
+        eng2.journal.close_clean()
+
+        # union of both lives' streams: every token exactly once, and
+        # the whole request bit-identical to the sequential oracle
+        per: dict[str, list[int]] = {}
+        for evs in (s1, s2):
+            for ev in evs:
+                if ev.kind == "token" and ev.token is not None:
+                    per.setdefault(ev.request.id, []).append(ev.token)
+        for r in reqs:
+            ref = np.asarray(generate(
+                model, variables, jnp.asarray(r.prompt_ids)[None],
+                r.max_new_tokens))[0].tolist()
+            assert per[r.id] == ref, (
+                f"{r.id}: stream {per[r.id]} != oracle {ref}")
+        assert eng2.compile_stats() == stats0, (
+            "speculative churn recompiled the engine")
+        m1, m2 = eng1.metrics.summary(), eng2.metrics.summary()
+        assert m1["preempted"] + m2["preempted"] > 0, (
+            "churn produced no pool-exhaustion preemption")
+        assert m1["spec_drafted"] + m2["spec_drafted"] > 0
+        # a clean journal owes nothing to the next life
+        assert RequestJournal(jp).pending_count() == 0
+
+    def test_spec_off_is_default_and_rejects_bad_config(self, llama):
+        model, variables = llama
+        assert EngineConfig(slots=3, max_len=48).spec_k == 0
+        with pytest.raises(ValueError):
+            Engine(model, variables,
+                   EngineConfig(slots=3, max_len=48, spec_k=2,
+                                draft="beam"))
+        with pytest.raises(ValueError):
+            Engine(model, variables,
+                   EngineConfig(slots=3, max_len=48, spec_k=-1))
 
 
 class TestDrain:

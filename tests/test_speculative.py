@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from hyperion_tpu.infer.generate import generate
-from hyperion_tpu.infer.speculative import generate_speculative
+from hyperion_tpu.infer.speculative import accept_draft, generate_speculative
 from hyperion_tpu.models.llama import Llama, llama_tiny_config
 
 
@@ -76,11 +76,63 @@ class TestEqualsGreedy:
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref_eos))
 
 
-class TestValidation:
-    def test_batch_must_be_one(self, target):
+class TestAcceptDraft:
+    """The shared acceptance rule (`accept_draft`) — also the serve
+    engine's verify step, so its contract is pinned here directly."""
+
+    def test_partial_prefix_takes_correction(self):
+        m, v = accept_draft(jnp.array([[5, 6, 7]]),
+                            jnp.array([[5, 6, 9, 8]]))
+        assert int(m[0]) == 2
+        # accepted tokens are v[:m+1]: the agreeing prefix plus the
+        # target's correction at the first disagreement
+        np.testing.assert_array_equal(np.asarray(v)[0, :3], [5, 6, 9])
+
+    def test_full_accept_takes_bonus(self):
+        m, v = accept_draft(jnp.array([[5, 6, 7]]),
+                            jnp.array([[5, 6, 7, 8]]))
+        assert int(m[0]) == 3
+        np.testing.assert_array_equal(np.asarray(v)[0], [5, 6, 7, 8])
+
+    def test_immediate_miss(self):
+        m, v = accept_draft(jnp.array([[9, 9]]), jnp.array([[5, 6, 7]]))
+        assert int(m[0]) == 0
+        assert int(np.asarray(v)[0, 0]) == 5
+
+    def test_batched_rows_independent(self):
+        draft = jnp.array([[5, 6], [1, 2]])
+        target = jnp.array([[5, 6, 7], [3, 4, 5]])
+        m, _ = accept_draft(draft, target)
+        np.testing.assert_array_equal(np.asarray(m), [2, 0])
+
+
+class TestBatched:
+    """Batch lifting (PR 12): rows are independent vmapped lanes, and
+    the batch-1 call bypasses vmap entirely so the original
+    single-sequence output stays byte-identical."""
+
+    def test_batched_rows_equal_greedy_and_solo(self, target):
+        # one batched trace covers both pins: every row equals plain
+        # greedy decoding, and row 0 equals the batch-1 (vmap-bypassed)
+        # call — so batching changed scheduling, not numerics
         model, variables = target
-        ids = jnp.ones((2, 8), jnp.int32)
-        with pytest.raises(ValueError, match="batch-1"):
+        prompts = jax.random.randint(
+            jax.random.key(11), (2, 8), 1, 250, jnp.int32)
+        out = generate_speculative(
+            model, variables, model, variables, prompts, 10, k=3)
+        ref = generate(model, variables, prompts, 10)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        solo = generate_speculative(
+            model, variables, model, variables, prompts[:1], 10, k=3)
+        np.testing.assert_array_equal(
+            np.asarray(out)[0], np.asarray(solo)[0])
+
+
+class TestValidation:
+    def test_empty_batch_rejected(self, target):
+        model, variables = target
+        ids = jnp.ones((0, 8), jnp.int32)
+        with pytest.raises(ValueError, match="at least one row"):
             generate_speculative(model, variables, model, variables, ids, 4)
 
     def test_prompt_longer_than_k(self, target):
