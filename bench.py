@@ -315,7 +315,10 @@ def _child_serving() -> None:
         EngineConfig(slots=4, max_len=128, eos_id=None,
                      queue_capacity=8, prefill_budget=96,
                      slo_ttft_p99_ms=10_000.0, slo_availability=0.5,
-                     slo_fast_s=5.0, slo_slow_s=20.0),
+                     slo_fast_s=5.0, slo_slow_s=20.0,
+                     # the probe is the one place the AOT cost pull is
+                     # cheap and worth keeping on the record
+                     ledger_costs=True),
     )
     shared = 64
     spec = LoadSpec(n_requests=32, rate_hz=100.0,
@@ -325,6 +328,15 @@ def _child_serving() -> None:
     engine.warmup([shared + p for p in spec.prompt_lens])
     report = run_load(engine, spec)
     report["compile"] = engine.compile_stats()
+    # compile ledger: per-executable warmup wall seconds (+ AOT
+    # FLOPs/bytes) ride the row so a compile-time regression is diffable
+    # like a throughput one; `recompiles` (post-warmup growth) comes via
+    # run_load and is gated at zero
+    led = engine.ledger.warmup or {}
+    report["compile_s"] = led.get("compile_s") or {}
+    report["compile_total_s"] = led.get("total_s")
+    if led.get("costs"):
+        report["compile_costs"] = led["costs"]
 
     # ---- the @spec dimension: speculative decoding off vs k∈{2,4} on
     # a longer-decode cut of the SAME seeded shared-prefix workload

@@ -95,7 +95,18 @@ METRICS: dict[str, str] = {
     "serve_scale_scaleup": "higher",
     "serve_scale_fairness": "higher",
     "serve_affinity_hit_rate": "higher",
+    # compile ledger (obs/ledger.py via the bench serving row): post-
+    # warmup jit-cache growth. Zero-pinned: the healthy value is
+    # EXACTLY 0, so any increase is a regression regardless of the
+    # percent threshold (see ZERO_PINNED below)
+    "serve_recompiles": "lower",
 }
+
+# metrics whose healthy value is exactly zero: the percent-threshold
+# machinery is meaningless at a zero base (0 -> 1 is an infinite
+# increase), so any move OFF zero in the bad direction regresses —
+# these skip the zero-base bail-out in `diff()` instead of hiding in it
+ZERO_PINNED = frozenset({"serve_recompiles"})
 
 
 def _num(v) -> float | None:
@@ -170,7 +181,8 @@ def normalize(doc: dict) -> dict[str, float]:
                               ("alerts_raised", "serve_alerts_raised"),
                               ("accept_rate", "serve_accept_rate"),
                               ("tokens_per_tick",
-                               "serve_tokens_per_tick")):
+                               "serve_tokens_per_tick"),
+                              ("recompiles", "serve_recompiles")):
                 v = _num(srv.get(src))
                 if v is not None:
                     out[name] = v
@@ -231,6 +243,23 @@ def diff(a: dict, b: dict, threshold: float = 0.10) -> dict:
     rows = []
     for name, direction in METRICS.items():
         va, vb = a["metrics"].get(name), b["metrics"].get(name)
+        if name in ZERO_PINNED:
+            # zero-pinned gate: the healthy value IS 0, so the zero-base
+            # skip below would hide exactly the regressions this metric
+            # exists to catch. Any move in the bad direction regresses,
+            # threshold be damned (0 recompiles -> 1 is a broken
+            # invariant, not a 10% drift).
+            if va is None or vb is None:
+                continue
+            worse = vb > va if direction == "lower" else vb < va
+            rows.append({
+                "metric": name, "a": va, "b": vb,
+                "delta_pct": (round(100 * (vb - va) / abs(va), 2)
+                              if va else None),
+                "better": direction,
+                "regression": bool(worse),
+            })
+            continue
         if va is None or vb is None or va == 0:
             continue  # a zero base has no percent delta (a dead-tunnel
             # 0.0 headline should be triaged by doctor, not diffed)

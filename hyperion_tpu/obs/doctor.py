@@ -44,6 +44,11 @@ from pathlib import Path
 
 from hyperion_tpu.obs.heartbeat import heartbeat_age_s, read_heartbeat
 from hyperion_tpu.obs.registry import percentile
+from hyperion_tpu.obs.tickprof import (
+    FLIGHT_NAME,
+    flight_final_tick,
+    read_flight,
+)
 
 _TERMINAL_EVENTS = ("train_end", "generate_done", "publish", "serve_end",
                     "router_end")
@@ -71,6 +76,26 @@ TAIL_DOMINANT_FRAC = 0.4
 # forward is mostly wasted work — the run pays spec overhead for
 # roughly sequential progress, so the draft config is a named incident
 SPEC_ACCEPT_FLOOR = 0.3
+# host-tick-profile threshold (obs/tickprof.py): a NON-device segment
+# owning at least this fraction of tick wall earns a named incident —
+# the serving loop is then host-bound, and the segment name says where
+HOST_SEGMENT_FRAC = 0.4
+_HOST_SEGMENT_MIN_TICKS = 8   # below this the window is noise
+# host-leak heuristic: peak RSS still climbing at the newest snapshots
+# AND up more than this factor over the run — a plateaued process
+# (normal warmup growth) fails the "still rising" half
+RSS_CLIMB_RATIO = 1.15
+_SEGMENT_HINTS = {
+    "journal": "slow disk under the request journal (append/fsync)",
+    "sink": "slow clients on the transport sinks",
+    "queue_pop": "admission-queue contention",
+    "admit": "prefill/admission host work",
+    "draft": "draft proposal building",
+    "bt_upload": "block-table re-uploads — table churning every tick",
+    "accept": "token-accept host path",
+    "slo": "metrics/SLO evaluation overhead",
+    "other": "unattributed host work",
+}
 
 
 def locate(target: str | Path) -> tuple[Path, Path]:
@@ -209,6 +234,11 @@ def diagnose(
         }
     if hb is not None and hb.get("run") not in (None, run):
         hb = None  # a later run's heartbeat says nothing about this one
+    # flight record (obs/tickprof.py): the engine's last spill, living
+    # next to the heartbeat — survives any kill the spill preceded
+    flight = read_flight(hb_path.parent / FLIGHT_NAME)
+    if flight is not None and flight.get("run") not in (None, run):
+        flight = None
 
     events = [r for r in recs if r.get("kind") == "event"]
     spans = [r for r in recs if r.get("kind") == "span"]
@@ -253,12 +283,22 @@ def diagnose(
     hbm_peak = None
     input_frac = input_wait_s = None
     serve: dict | None = None
+    tickprof: dict | None = None
+    rss_series: list[float] = []
     for s in snapshots:
         m = s.get("metrics", {})
         g = m.get("gauges", {})
         p = g.get("hbm_peak_mb")
         if p is not None:
             hbm_peak = p if hbm_peak is None else max(hbm_peak, p)
+        # host-tick profile rides each serve snapshot as a top-level
+        # attr; last snapshot wins ("where is host time going NOW")
+        if isinstance(s.get("tickprof"), dict):
+            tickprof = s["tickprof"]
+        # host RSS as a SERIES across snapshots — the leak warning
+        # needs the trend, not the final value
+        if isinstance(g.get("host_rss_mb"), (int, float)):
+            rss_series.append(float(g["host_rss_mb"]))
         # input-wait evidence: the LAST epoch's snapshot wins (the
         # question is "is it input-bound NOW", not "was it ever")
         if isinstance(g.get("input_wait_frac"), (int, float)):
@@ -305,7 +345,14 @@ def diagnose(
                 "spec_rejected": c.get("serve_spec_rejected"),
                 "accept_rate": g.get("serve_spec_accept_rate"),
                 "tokens_per_tick": g.get("serve_tokens_per_tick"),
+                # compile ledger (obs/ledger.py)
+                "recompiles": c.get("serve_recompiles"),
             }
+    if tickprof is None and flight is not None \
+            and isinstance(flight.get("tickprof"), dict):
+        # a killed process may never have snapshotted: the flight
+        # record's windowed breakdown is the fallback evidence
+        tickprof = flight["tickprof"]
 
     # ---- stall signal: tail steps vs the run's own earlier median ----
     stall = None
@@ -393,6 +440,33 @@ def diagnose(
     else:
         verdict = "running"
         reason = "stream active, no terminal event yet"
+
+    # Flight-record citation (obs/tickprof.py): for a dead process the
+    # record's final ticks are the best evidence of what the loop was
+    # doing when it stopped — cite them in the verdict itself.
+    flight_summary = None
+    if flight is not None:
+        ftick = flight_final_tick(flight)
+        ftp = flight.get("tickprof") or {}
+        flight_summary = {
+            "final_tick": ftick,
+            "reason": flight.get("reason"),
+            "spills": flight.get("spills"),
+            "active": flight.get("active"),
+            "queue": flight.get("queue"),
+            "dominant": ftp.get("dominant"),
+            "dominant_frac": ftp.get("dominant_frac"),
+        }
+        if verdict in ("crashed", "hung"):
+            seg_txt = ""
+            if ftp.get("dominant"):
+                seg_txt = (f", dominant segment {ftp['dominant']} "
+                           f"{100 * (ftp.get('dominant_frac') or 0):.0f}%")
+            reason += (
+                f"; flight record: last spill at tick {_fmt(ftick)} "
+                f"(reason={flight.get('reason')!r}, "
+                f"{_fmt(flight.get('active'))} active + "
+                f"{_fmt(flight.get('queue'))} queued{seg_txt})")
 
     # Orthogonal to liveness: a run can be perfectly healthy AND
     # input-bound — compute idling while the host assembles batches.
@@ -591,6 +665,77 @@ def diagnose(
                                       "failed"):
         reason += "; tail attribution: " + "; ".join(tail_incidents)
 
+    # Recompile incident (obs/ledger.py): post-warmup jit-cache growth
+    # is a broken invariant — name the executable and the churn context
+    # ONCE however many times it fired, so the incident reads as one
+    # diagnosis, not a stutter.
+    recompile_events = [e for e in events
+                        if e.get("name") == "recompile_after_warmup"]
+    recompile_incidents: list[str] = []
+    if recompile_events:
+        execs = sorted({str(e.get("executable"))
+                        for e in recompile_events})
+        total = (int(serve["recompiles"])
+                 if serve and isinstance(serve.get("recompiles"),
+                                         (int, float))
+                 and serve["recompiles"]
+                 else len(recompile_events))
+        last = recompile_events[-1]
+        ctx = ""
+        if last.get("last_prefill_bucket") is not None:
+            ctx = (f"; last prefill bucket "
+                   f"{last['last_prefill_bucket']}, "
+                   f"tick {_fmt(last.get('tick'))}")
+        recompile_incidents.append(
+            f"recompile after warmup: {total} new executable(s) in "
+            f"{', '.join(execs)}{ctx} — a shape escaped the warmup "
+            "ladder; extend warmup prompt_lens or check the bucket "
+            "config")
+    if recompile_incidents and verdict in ("healthy", "running",
+                                           "stalled", "failed",
+                                           "crashed", "hung"):
+        reason += "; compile: " + "; ".join(recompile_incidents)
+
+    # Dominant-host-segment incident (obs/tickprof.py): when a NON-
+    # device segment owns the tick wall, tokens/s is host-bound and the
+    # segment name says exactly where ("journal owns 61% — slow disk").
+    host_segment_incidents: list[str] = []
+    if tickprof and (tickprof.get("ticks") or 0) >= _HOST_SEGMENT_MIN_TICKS:
+        dom = tickprof.get("dominant")
+        frac = tickprof.get("dominant_frac") or 0.0
+        if dom and dom != "device" and frac >= HOST_SEGMENT_FRAC:
+            host_segment_incidents.append(
+                f"host segment '{dom}' owns {100 * frac:.0f}% of tick "
+                f"time over the last {tickprof.get('ticks')} tick(s) — "
+                f"{_SEGMENT_HINTS.get(dom, 'host-side work')}")
+    if host_segment_incidents and verdict in ("healthy", "running",
+                                              "stalled", "failed",
+                                              "crashed", "hung"):
+        reason += "; host profile: " + "; ".join(host_segment_incidents)
+
+    # Host RSS trend (heartbeat/engine rss_mb): ru_maxrss is a peak, so
+    # it never falls — the leak signal is a peak STILL RISING at the
+    # newest snapshots after a material climb, which steady-state
+    # serving (plateaued after warmup) stops doing.
+    rss_trend = None
+    rss_warning = None
+    if rss_series:
+        rss_trend = {"first_mb": round(rss_series[0], 1),
+                     "last_mb": round(rss_series[-1], 1),
+                     "samples": len(rss_series)}
+        if len(rss_series) >= 4 and rss_series[0] > 0:
+            climb = rss_series[-1] / rss_series[0]
+            t3 = rss_series[-3:]
+            if climb > RSS_CLIMB_RATIO and t3[0] < t3[1] < t3[2]:
+                rss_warning = (
+                    f"host RSS climbing monotonically "
+                    f"({rss_series[0]:.0f} -> {rss_series[-1]:.0f} MB, "
+                    f"x{climb:.2f}, still rising at the last 3 "
+                    "snapshots) — possible host-side leak")
+    if rss_warning and verdict in ("healthy", "running", "stalled",
+                                   "failed"):
+        reason += "; memory: " + rss_warning
+
     last_span = spans[-1] if spans else None
     return {
         "target": str(target),
@@ -637,6 +782,13 @@ def diagnose(
         "tail_attribution": tail_rows,
         "tail_incidents": tail_incidents,
         "tail_incident_metrics": tail_incident_metrics,
+        # introspection plane (obs/ledger.py, obs/tickprof.py)
+        "tickprof": tickprof,
+        "recompile_incidents": recompile_incidents,
+        "host_segment_incidents": host_segment_incidents,
+        "rss_trend": rss_trend,
+        "rss_warning": rss_warning,
+        "flight": flight_summary,
         "heartbeat": {
             "phase": hb.get("phase"), "step": hb.get("step"),
             "pid": hb.get("pid"), "beats": hb.get("beats"),
@@ -764,6 +916,42 @@ def render_markdown(d: dict) -> str:
                 f"{_fmt(srv.get('spec_rejected'))}, accept rate "
                 f"{_fmt(srv.get('accept_rate'))}, "
                 f"{_fmt(srv.get('tokens_per_tick'))} tokens/tick{flag} |")
+    # counter from the last snapshot when one landed, else the event
+    # count — a short churned run with no snapshot still renders the
+    # broken invariant
+    n_rec = ((srv or {}).get("recompiles")
+             or len(d.get("recompile_incidents") or []))
+    if n_rec:
+        lines.append(
+            f"| serve compile | {_fmt(n_rec)} "
+            "post-warmup recompile(s) — **broken invariant** |")
+    tp = d.get("tickprof")
+    if tp and tp.get("dominant"):
+        flag = (" — **host-bound**"
+                if d.get("host_segment_incidents") else "")
+        frac = tp.get("dominant_frac")
+        lines.append(
+            f"| host tick profile | dominant `{tp['dominant']}` "
+            f"{100 * frac:.0f}% over {_fmt(tp.get('ticks'))} tick(s)"
+            f"{flag} |" if isinstance(frac, (int, float)) else
+            f"| host tick profile | dominant `{tp['dominant']}` over "
+            f"{_fmt(tp.get('ticks'))} tick(s){flag} |")
+    rt = d.get("rss_trend")
+    if rt:
+        flag = " — **climbing**" if d.get("rss_warning") else ""
+        lines.append(
+            f"| host RSS | {_fmt(rt['first_mb'])} → {_fmt(rt['last_mb'])}"
+            f" MB over {rt['samples']} snapshot(s){flag} |")
+    fl = d.get("flight")
+    if fl:
+        seg = (f", dominant `{fl['dominant']}`" if fl.get("dominant")
+               else "")
+        lines.append(
+            f"| flight record | last spill at tick "
+            f"{_fmt(fl.get('final_tick'))} (reason "
+            f"{fl.get('reason')!r}, {_fmt(fl.get('spills'))} spill(s), "
+            f"active {_fmt(fl.get('active'))}, queue "
+            f"{_fmt(fl.get('queue'))}{seg}) |")
     for row in d.get("slo_alerts") or []:
         flag = " — **FIRING**" if row.get("active") else " (cleared)"
         lines.append(

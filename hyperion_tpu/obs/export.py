@@ -9,14 +9,18 @@ state — registry counters/gauges, windowed histogram summaries
 flags, firing alerts — so `obs top` renders current truth for running
 fleets and falls back to heartbeat files only for the dead ones.
 
-Protocol, deliberately the dumbest thing that works: connect, read one
-JSON line, EOF. No request body, no framing, no version negotiation
-beyond the `v` field — a `nc -U <sock>` is a valid client. The payload
-is built by a caller-supplied `payload_fn` on the EXPORTER thread from
-host-side state only (python floats, bounded ring copies): answering a
-snapshot request can never add a device sync or a jit trace to the
-serving loop, which is the whole point of exposing metrics the loop
-already keeps instead of measuring anything new.
+Protocol, deliberately the dumbest thing that works: connect, send one
+OPTIONAL JSON request line (or nothing at all), read one JSON line,
+EOF. A client that sends an empty line — or goes quiet for 250 ms, so
+a bare `nc -U <sock>` still works — gets the default snapshot; a JSON
+dict with a `"cmd"` key is routed to the owner's `control_fn` (on-
+demand profiling lives there), answered with the verb's own JSON
+reply. No framing, no version negotiation beyond the `v` field. The
+payload is built by a caller-supplied `payload_fn` on the EXPORTER
+thread from host-side state only (python floats, bounded ring copies):
+answering a snapshot request can never add a device sync or a jit
+trace to the serving loop, which is the whole point of exposing
+metrics the loop already keeps instead of measuring anything new.
 
 Failure posture matches the heartbeat's: a socket that cannot bind, a
 payload_fn that raises, a client that disconnects mid-write — all
@@ -88,9 +92,12 @@ class MetricsExporter:
     observes."""
 
     def __init__(self, socket_path: str | Path, payload_fn, *,
-                 label: str = "obs-export"):
+                 label: str = "obs-export", control_fn=None):
         self.socket_path = str(socket_path)
         self._payload_fn = payload_fn
+        # optional `control_fn(req: dict) -> dict` for "cmd" requests
+        # (engine.control): absent -> every request gets the snapshot
+        self._control_fn = control_fn
         self._label = label
         self._srv = None
         self._thread: threading.Thread | None = None
@@ -105,16 +112,46 @@ class MetricsExporter:
         import socketserver
 
         payload_fn = self._payload_fn
+        control_fn = self._control_fn
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
+                req = None
                 try:
-                    doc = payload_fn()
-                    if not isinstance(doc, dict):
-                        doc = {"error": "payload_fn returned non-dict"}
-                except Exception as e:  # noqa: BLE001 — a snapshot bug
-                    doc = {"error": repr(e)[:500]}  # must answer, not kill
-                rec = {"v": OBS_SCHEMA, "kind": "exposition",
+                    # one optional request line: well-behaved clients
+                    # (read_exposition) send at least b"\n" so the fast
+                    # path never waits; a silent `nc -U` pays 250 ms
+                    # and still gets the default snapshot
+                    self.connection.settimeout(0.25)
+                    line = self.rfile.readline(65536).strip()
+                    if line:
+                        req = json.loads(line.decode("utf-8"))
+                except (OSError, json.JSONDecodeError,
+                        UnicodeDecodeError, ValueError):
+                    req = None
+                finally:
+                    try:
+                        self.connection.settimeout(5.0)
+                    except OSError:
+                        pass
+                if (isinstance(req, dict) and req.get("cmd")
+                        and control_fn is not None):
+                    kind = "control"
+                    try:
+                        doc = control_fn(req)
+                        if not isinstance(doc, dict):
+                            doc = {"error": "control_fn returned non-dict"}
+                    except Exception as e:  # noqa: BLE001
+                        doc = {"error": repr(e)[:500]}
+                else:
+                    kind = "exposition"
+                    try:
+                        doc = payload_fn()
+                        if not isinstance(doc, dict):
+                            doc = {"error": "payload_fn returned non-dict"}
+                    except Exception as e:  # noqa: BLE001 — a snapshot bug
+                        doc = {"error": repr(e)[:500]}  # answer, not kill
+                rec = {"v": OBS_SCHEMA, "kind": kind,
                        "pid": os.getpid(), "t_wall": time.time(), **doc}
                 try:
                     self.wfile.write(
@@ -178,12 +215,32 @@ def read_exposition(socket_path: str | Path,
                     timeout_s: float = 1.0) -> dict | None:
     """One snapshot request; None when nothing (or nothing parseable)
     answers — the caller's signal to fall back to the heartbeat file."""
+    return _roundtrip(socket_path, b"\n", timeout_s)
+
+
+def request_control(socket_path: str | Path, req: dict,
+                    timeout_s: float = 5.0) -> dict | None:
+    """Send one control verb (`{"cmd": ...}`) to a live exposition
+    socket; the owner's `control_fn` answers. None when nothing
+    answers or the owner predates the request-line protocol."""
+    line = json.dumps(req, separators=(",", ":")).encode("utf-8") + b"\n"
+    return _roundtrip(socket_path, line, timeout_s)
+
+
+def _roundtrip(socket_path: str | Path, request: bytes,
+               timeout_s: float) -> dict | None:
     buf = b""
     try:
         with socket_mod.socket(socket_mod.AF_UNIX,
                                socket_mod.SOCK_STREAM) as s:
             s.settimeout(timeout_s)
             s.connect(str(socket_path))
+            # the (possibly empty) request line lets the exporter skip
+            # its read timeout; pre-protocol servers just ignore it
+            try:
+                s.sendall(request)
+            except OSError:
+                pass
             while not buf.endswith(b"\n"):
                 chunk = s.recv(65536)
                 if not chunk:
@@ -196,3 +253,42 @@ def read_exposition(socket_path: str | Path,
     except (json.JSONDecodeError, UnicodeDecodeError):
         return None
     return doc if isinstance(doc, dict) else None
+
+
+def profile_main(argv: list[str] | None = None) -> int:
+    """`obs profile <dir> --seconds N [--out DIR]` — ask the live
+    process whose obs.sock lives at/next to <dir> to capture an
+    on-demand `jax.profiler` trace (TensorBoard/Perfetto-openable).
+    Exit 0 when the trace started (or was already running), 1 when the
+    backend cannot profile or nothing answered."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="obs profile",
+        description="request an on-demand jax.profiler trace from a "
+                    "live process via its exposition socket")
+    ap.add_argument("dir", help="run dir / heartbeat path whose "
+                                "obs.sock to talk to")
+    ap.add_argument("--seconds", type=float, default=5.0,
+                    help="trace duration (default 5)")
+    ap.add_argument("--out", default=None,
+                    help="trace output dir (default <dir>/profile)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw reply as JSON")
+    args = ap.parse_args(argv)
+    sock = exposition_path(args.dir)
+    out = args.out or str(Path(args.dir) / "profile")
+    reply = request_control(
+        sock, {"cmd": "profile", "seconds": args.seconds, "out": out},
+        timeout_s=max(5.0, args.seconds + 5.0))
+    if reply is None:
+        print(f"no live process answered at {sock}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(reply, indent=2, default=repr))
+    else:
+        status = reply.get("status", "error")
+        print(f"profile: {status}"
+              + (f" -> {reply.get('dir')}" if reply.get("dir") else "")
+              + (f" ({reply.get('error')})" if reply.get("error") else ""))
+    return 0 if reply.get("status") in ("started", "busy") else 1

@@ -41,6 +41,21 @@ SCHEMA_VERSION = 1
 ENV_VAR = "HYPERION_HEARTBEAT"
 
 
+def host_rss_mb() -> float | None:
+    """This process's peak resident set in MB, from `getrusage` (stdlib,
+    no psutil). Linux reports `ru_maxrss` in KB; it is a HIGH-WATER
+    mark, so the value never decreases — trend readers (doctor's
+    host-leak warning) look for a peak that is STILL RISING late in a
+    run, which a plateaued process stops doing. None where the platform
+    has no usable counter."""
+    try:
+        import resource
+        kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return round(kb / 1024.0, 1) if kb > 0 else None
+    except Exception:  # noqa: BLE001 — absent evidence, not a crash
+        return None
+
+
 class Heartbeat:
     """Rate-limited atomic writer of one run's heartbeat file.
 
@@ -138,6 +153,9 @@ class Heartbeat:
             "t_wall": self._wall(),
             "t_mono": self._last_t,
             "beats": self._beats,
+            # host memory on every beat: the heartbeat is what outlives
+            # a kill, so the last-known RSS is post-mortem evidence
+            "rss_mb": host_rss_mb(),
             **self.static,
             **extra,
         }

@@ -372,6 +372,31 @@ def compiled_flops(jitted, *args, **kwargs) -> float | None:
         return None
 
 
+def compiled_cost(jitted, *args, **kwargs) -> dict | None:
+    """FLOPs AND bytes accessed of one execution, same machinery as
+    `compiled_flops` but returning every positive numeric the backend's
+    `cost_analysis()` exposes (keys vary by backend/version: "flops",
+    "bytes accessed", ...). Keys are slug-cased for JSON friendliness;
+    None when the backend offers no analysis."""
+    try:
+        ca = jitted.lower(*args, **kwargs).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if not hasattr(ca, "items"):
+            return None
+        out = {}
+        for k, v in ca.items():
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                continue
+            if v > 0 and ("flops" in k or "bytes" in k):
+                out[k.replace(" ", "_").replace("{", "").replace("}", "")] = v
+        return out or None
+    except Exception:  # noqa: BLE001 — telemetry must never kill a run
+        return None
+
+
 _MEASURED_HOST_PEAK: list[float | None] = []  # one-element memo
 
 

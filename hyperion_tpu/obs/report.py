@@ -207,11 +207,16 @@ def main(argv=None) -> int:
         from hyperion_tpu.obs.top import main as top_main
 
         return top_main(argv[1:])
+    if argv and argv[0] == "profile":
+        from hyperion_tpu.obs.export import profile_main
+
+        return profile_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="hyperion obs",
         description="telemetry stream tools (obs/report.py); see also "
                     "`obs doctor <dir>`, `obs diff <a> <b>`, "
-                    "`obs trace <dir>`, and `obs top <dir>`",
+                    "`obs trace <dir>`, `obs top <dir>`, and "
+                    "`obs profile <dir>`",
     )
     sub = p.add_subparsers(dest="cmd", required=True)
     sub.add_parser("doctor", help="classify a run (healthy/crashed/hung/"
@@ -226,6 +231,9 @@ def main(argv=None) -> int:
                                "exposition sockets (heartbeat fallback "
                                "for dead processes); --once --json for "
                                "scripting")
+    sub.add_parser("profile", help="request an on-demand jax.profiler "
+                                   "trace from a live process via its "
+                                   "exposition socket")
     s = sub.add_parser("summarize", help="render a run summary from a "
                                          "telemetry JSONL")
     s.add_argument("telemetry", help="path to telemetry.jsonl")

@@ -49,7 +49,10 @@ _RESET = "\x1b[0m"
 ROW_KEYS = ("name", "dir", "source", "state", "pid", "phase", "step",
             "active", "slots", "occupancy", "queue", "tokens_per_s",
             "ttft_p99_ms", "blocks_in_use", "brownout", "draining",
-            "alerts", "age_s", "restarts", "window_s")
+            "alerts", "age_s", "restarts", "window_s",
+            # introspection plane: the windowed dominant host segment
+            # (obs/tickprof.py vocabulary) and host RSS in MB
+            "dominant_segment", "rss_mb")
 
 
 def discover(base: str | Path) -> list[tuple[str, Path]]:
@@ -103,6 +106,9 @@ def _row_from_exposition(row: dict, exp: dict) -> dict:
         row["occupancy"] = gauges.get("slot_occupancy")
     if row["blocks_in_use"] is None:
         row["blocks_in_use"] = gauges.get("serve_blocks_in_use")
+    tp = exp.get("tickprof") or {}
+    row["dominant_segment"] = tp.get("dominant")
+    row["rss_mb"] = (exp.get("memory") or {}).get("rss_mb")
     return row
 
 
@@ -123,6 +129,7 @@ def _row_from_heartbeat(row: dict, hb: dict | None, *, now: float,
                phase=phase, step=hb.get("step"),
                active=hb.get("active"), queue=hb.get("queue"),
                alerts=list(hb.get("alerts") or []),
+               rss_mb=hb.get("rss_mb"),
                age_s=round(age, 1) if age is not None else None)
     return row
 
@@ -168,6 +175,7 @@ def render(rows: list[dict], base: str, *, window_s: float,
     cols = [("process", 11), ("state", 12), ("pid", 7), ("phase", 10),
             ("tick", 6), ("occ", 5), ("queue", 5), ("tok/s", 8),
             (f"ttft p99({window_s:.0f}s)", 14), ("blocks", 6),
+            ("seg", 9), ("rss", 7),
             ("brown", 5), ("alerts", 18), ("age", 5)]
     head = " ".join(f"{n:<{w}}" for n, w in cols)
     lines = [
@@ -181,10 +189,14 @@ def render(rows: list[dict], base: str, *, window_s: float,
                else (f"{r['active']}" if r["active"] is not None else "—"))
         p99 = (f"{r['ttft_p99_ms']:.1f}ms"
                if isinstance(r["ttft_p99_ms"], (int, float)) else "—")
+        rss = (f"{r['rss_mb']:.0f}M"
+               if isinstance(r["rss_mb"], (int, float)) else "—")
         cells = [r["name"], r["state"] or "?", _fmt(r["pid"]),
                  _fmt(r["phase"]), _fmt(r["step"]), occ,
                  _fmt(r["queue"]), _fmt(r["tokens_per_s"]), p99,
-                 _fmt(r["blocks_in_use"]), _fmt(bool(r["brownout"])),
+                 _fmt(r["blocks_in_use"]),
+                 _fmt(r["dominant_segment"]), rss,
+                 _fmt(bool(r["brownout"])),
                  ",".join(r["alerts"] or []) or "-", _fmt(r["age_s"], 0)]
         line = " ".join(f"{str(c):<{w}}" for c, (_, w) in zip(cells, cols))
         if color:

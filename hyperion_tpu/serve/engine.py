@@ -85,6 +85,13 @@ from hyperion_tpu.serve.blocks import (
 )
 from hyperion_tpu.obs import slo as slo_mod
 from hyperion_tpu.obs.export import DEFAULT_WINDOW_S
+from hyperion_tpu.obs.heartbeat import host_rss_mb as hb_host_rss_mb
+from hyperion_tpu.obs.ledger import CompileLedger
+from hyperion_tpu.obs.tickprof import (
+    FlightRecorder,
+    TickProfiler,
+    null_flight_recorder,
+)
 from hyperion_tpu.serve.journal import MAX_REPLAYS_DEFAULT
 from hyperion_tpu.serve.metrics import ServeMetrics
 from hyperion_tpu.serve.queue import (
@@ -323,6 +330,11 @@ class EngineConfig:
     slo_availability: float = 0.0  # windowed completed/(completed+failed) floor
     slo_fast_s: float = 0.0        # fast burn window (0 = obs/slo default 60s)
     slo_slow_s: float = 0.0        # slow burn window (0 = obs/slo default 600s)
+    # ---- introspection (obs/ledger.py, obs/tickprof.py) ----
+    # opt-in AOT cost_analysis at warmup: `lower().compile()` compiles
+    # AGAIN outside the jit cache — real wall time bench pays once per
+    # round but the test suite must not pay hundreds of times
+    ledger_costs: bool = False
 
 
 @dataclasses.dataclass
@@ -356,6 +368,7 @@ class Engine:
         chaos=None,
         journal=None,
         on_event: Callable[[TokenEvent], Any] | None = None,
+        flight_path=None,
     ):
         from hyperion_tpu.models.llama import (
             init_paged_cache,
@@ -445,6 +458,19 @@ class Engine:
         # write in the window, not just the request's own — a slow
         # neighbour's client must not read as this slot's decode time
         self._sink_s = 0.0
+        # introspection plane: compile ledger + host-tick profiler +
+        # flight recorder (all host-only — none touch the device)
+        self.ledger = CompileLedger()
+        self.tickprof = TickProfiler()
+        self.flight = (FlightRecorder(flight_path, run=self.tracer.run)
+                       if flight_path else null_flight_recorder())
+        self._journal_s = 0.0     # cumulative journal seconds (see _sink_s)
+        self._bt_upload_s = 0.0   # cumulative block-table upload seconds
+        self._last_prefill_bucket: int | None = None
+        # `.nbytes` is shape metadata — summing it syncs nothing
+        self._param_bytes = int(sum(
+            getattr(x, "nbytes", 0)
+            for x in jax.tree_util.tree_leaves(variables)))
         (self._tick_jit, self._prefill_jit, self._copy_jit,
          self._spec_jit) = _shared_jits(
             donate=jax.default_backend() != "cpu")
@@ -516,22 +542,33 @@ class Engine:
             if pb >= want:
                 break
             b *= 2
+        compile_s: dict[str, float] = {}
         with self.tracer.span("serve_warmup") as sp:
             for pb in lens:
                 dummy = Request(prompt_ids=np.ones((min(pb, 2),), np.int32),
                                 max_new_tokens=2)
                 # bt row is all-null during warmup: the dummy's writes
                 # land in the garbage block, real state is untouched
+                t0 = time.perf_counter()
                 self._prefill_call(dummy, slot=0, bucket_len=pb)
+                compile_s[f"prefill_b{pb}"] = round(
+                    time.perf_counter() - t0, 4)
+            t0 = time.perf_counter()
             _ = self._tick_device()
+            compile_s["tick"] = round(time.perf_counter() - t0, 4)
             if self._spec:
                 # the spec tick's one executable for this (S, k) —
                 # all-zero drafts exercise the same shapes live
                 # traffic will (acceptance is data, not shape)
+                t0 = time.perf_counter()
                 _ = self._spec_tick_device(
                     np.zeros((self.cfg.slots, self.cfg.spec_k), np.int32))
+                compile_s["spec_tick"] = round(time.perf_counter() - t0, 4)
             zero = jnp.zeros((1,), jnp.int32)
+            t0 = time.perf_counter()
             self._cache = self._copy_jit(self._cache, zero, zero)
+            compile_s["copy"] = round(time.perf_counter() - t0, 4)
+            costs = self._warmup_costs() if self.cfg.ledger_costs else None
             sp.set(buckets=lens)
         self._state = self._init_state()
         self._slots = [None] * self.cfg.slots
@@ -539,8 +576,29 @@ class Engine:
         self._bt[:] = 0
         self._bt_dev = None
         stats = self.compile_stats()
+        total_s = round(sp.dur_s or 0.0, 4)
+        self.ledger.record_warmup(stats, compile_s=compile_s, costs=costs,
+                                  total_s=total_s)
+        self.ledger.set_baseline(stats)
         self.tracer.event("serve_warmup_done", **stats)
+        self.tracer.event("compile_ledger", total_s=total_s,
+                          compile_s=compile_s, costs=costs or {}, **stats)
         return stats
+
+    def _warmup_costs(self) -> dict:
+        """Opt-in AOT `cost_analysis()` of the decode-tick executable —
+        FLOPs/bytes per tick for the ledger. `lower().compile()` builds
+        a SECOND executable outside the jit call cache (doesn't grow
+        `compile_stats()`, but costs real compile wall time), hence the
+        `ledger_costs` gate: bench pays it once per round, tests never."""
+        from hyperion_tpu.obs.registry import compiled_cost
+        live = np.fromiter((r is not None for r in self._slots),
+                           bool, len(self._slots))
+        cost = compiled_cost(
+            self._tick_jit, self.model, self.cfg.eos_id, self.cfg.pad_id,
+            self.variables, self._cache, self._state,
+            jnp.asarray(self._bt), jnp.asarray(live))
+        return {f"tick_{k}": v for k, v in (cost or {}).items()}
 
     def _prefill_call(self, req: Request, slot: int, *, start: int = 0,
                       prompt: np.ndarray | None = None,
@@ -550,6 +608,7 @@ class Engine:
         suffix = ids[start:]
         P = int(suffix.shape[0])
         Pb = bucket_len or self.bucket(P)
+        self._last_prefill_bucket = Pb   # churn context for the ledger
         buf = np.full((1, Pb), self.cfg.pad_id, np.int32)
         buf[0, :P] = suffix
         self._cache, self._state, first, finished = self._prefill_jit(
@@ -569,9 +628,11 @@ class Engine:
             # upload only when the table or slot liveness changed —
             # steady-state decode re-uses the device copies, so a tick
             # costs zero host->device traffic
+            t0u = time.monotonic()
             live = np.fromiter((r is not None for r in self._slots),
                                bool, len(self._slots))
             self._bt_dev = (jnp.asarray(self._bt), jnp.asarray(live))
+            self._bt_upload_s += time.monotonic() - t0u
         self._cache, self._state, toks, fins = self._tick_jit(
             self.model, self.cfg.eos_id, self.cfg.pad_id,
             self.variables, self._cache, self._state, *self._bt_dev)
@@ -593,9 +654,11 @@ class Engine:
 
     def _spec_tick_device(self, drafts: np.ndarray):
         if self._bt_dev is None:
+            t0u = time.monotonic()
             live = np.fromiter((r is not None for r in self._slots),
                                bool, len(self._slots))
             self._bt_dev = (jnp.asarray(self._bt), jnp.asarray(live))
+            self._bt_upload_s += time.monotonic() - t0u
         self._cache, self._state, out, cnt, acc, fins = self._spec_jit(
             self.model, self.cfg.eos_id, self.cfg.pad_id,
             self.variables, self._cache, self._state, *self._bt_dev,
@@ -881,6 +944,7 @@ class Engine:
         # can never re-compute — hence never re-deliver — it. The
         # client stream stays duplicate-free across kills.
         if self.journal is not None and req._journaled:
+            jt0 = time.monotonic()
             if ev.kind == "token" and ev.token is not None:
                 self.journal.token(req.id, ev.token)
             if ev.finished:
@@ -888,6 +952,12 @@ class Engine:
                     req.id,
                     "done" if ev.kind in ("token", "done")
                     else (ev.reason or ev.kind))
+            if ev.kind in ("token", "timed_out"):
+                # engine-thread emissions only (the _sink_s guard below,
+                # same reasoning): reject writes on front-end reader
+                # threads must not pollute the step profiler's journal
+                # segment
+                self._journal_s += time.monotonic() - jt0
             self._journal_guard()
         if self.chaos is not None:
             self.chaos.on_client(self._tick_no)
@@ -1162,7 +1232,65 @@ class Engine:
                        if self.slo is not None else []),
             "metrics": reg.snapshot(),
             "windows": reg.windowed_snapshot(window_s),
+            "memory": self.memory_ledger(),
+            "tickprof": self.tickprof.snapshot(window_s),
+            "compile": {**self.ledger.last_seen,
+                        "recompiles": self.ledger.recompiles},
         }
+
+    def memory_ledger(self) -> dict:
+        """Live memory accounting from known shapes — param bytes, the
+        KV pool's full and in-use footprint, host RSS. Pure host
+        arithmetic (`.nbytes` is metadata, `_block_bytes` a cached
+        int), so any thread may ask."""
+        bb = self._block_bytes
+        return {
+            "param_bytes": self._param_bytes,
+            "kv_pool_bytes": int(self.cfg.num_blocks * bb),
+            "blocks_in_use_bytes": int(self.mgr.in_use * bb),
+            "rss_mb": hb_host_rss_mb(),
+        }
+
+    def _flight_payload(self) -> dict:
+        """What a flight-record spill captures: loop state, the tick
+        ring's tail, the windowed breakdown, compile counts, memory."""
+        return {
+            "phase": self._phase(),
+            "active": self.n_active,
+            "queue": len(self.queue),
+            "ticks": self.tickprof.tail(32),
+            "tickprof": self.tickprof.snapshot(),
+            "compile": {**self.ledger.last_seen,
+                        "recompiles": self.ledger.recompiles},
+            "memory": self.memory_ledger(),
+        }
+
+    def flight_spill(self, reason: str, **extra) -> None:
+        """Spill the flight record NOW — the server's SIGTERM handler,
+        the fatal-exception path, and the final drain all call this.
+        Host-only, so safe from a signal handler's frame."""
+        if extra:
+            self.flight.note(reason, **extra)
+        self.flight.spill(reason, self._flight_payload(),
+                          tick=self._tick_no)
+
+    def control(self, req: dict) -> dict:
+        """Control verbs arriving on the exposition socket (the
+        request-line protocol in obs/export.py). `profile` brackets
+        `jax.profiler.start_trace/stop_trace` on demand; anything
+        unknown answers with an error dict instead of raising — the
+        exporter thread must never die of a bad request."""
+        cmd = req.get("cmd")
+        if cmd == "profile":
+            from hyperion_tpu.utils.profiling import on_demand_trace
+            out = req.get("out")
+            if not out:
+                return {"status": "error", "error": "profile needs 'out'"}
+            res = on_demand_trace(str(out),
+                                  float(req.get("seconds") or 5.0))
+            self.tracer.event("profile_requested", **res)
+            return res
+        return {"status": "error", "error": f"unknown cmd {cmd!r}"}
 
     def step(self) -> list[TokenEvent]:
         """One scheduling round: admit from the queue into free slots
@@ -1172,6 +1300,14 @@ class Engine:
         speculative tick — and route emissions."""
         emissions: list[TokenEvent] = []
         now = time.monotonic()
+        # host-tick profiler (obs/tickprof.py): stamp each segment of
+        # this step into `seg` — pure perf-counter arithmetic, no device
+        # interaction. Journal/sink time is accumulated inside _emit
+        # wherever it happens, so enclosing segments NET those deltas
+        # out rather than double-charging them.
+        seg: dict[str, float] = {}
+        p_start = now
+        j_start, s_start = self._journal_s, self._sink_s
 
         if self._governor is not None:
             tr = self._governor.update(len(self.queue))
@@ -1209,6 +1345,7 @@ class Engine:
                     self._emit(ev)
                     emissions.append(ev)
 
+        t_seg = time.monotonic()
         free = [s for s, r in enumerate(self._slots) if r is None]
         if free:
             admit, expired = self.queue.pop_ready(
@@ -1219,6 +1356,9 @@ class Engine:
             expired += self.queue.drop_expired(now)
         else:
             admit, expired = [], self.queue.drop_expired(now)
+        seg["queue_pop"] = time.monotonic() - t_seg
+        t_seg = time.monotonic()
+        j_mark, s_mark = self._journal_s, self._sink_s
         for req in expired:
             self.metrics.on_timeout()
             req.finish_reason = "timed_out"
@@ -1266,6 +1406,11 @@ class Engine:
             emissions.append(ev)
             if ev.finished:
                 self._on_finished(req)
+        # admit covers expiry + admission + their prefill calls, net of
+        # journal/sink writes those paths perform
+        seg["admit"] = max(0.0, (time.monotonic() - t_seg)
+                           - (self._journal_s - j_mark)
+                           - (self._sink_s - s_mark))
 
         if self.n_active:
             self._ensure_blocks()
@@ -1274,7 +1419,10 @@ class Engine:
                 self.chaos.on_tick(self._tick_no)
             spec = self._spec
             cnts = accs = None
+            t_seg = time.monotonic()
             drafts = self._collect_drafts() if spec else None
+            seg["draft"] = time.monotonic() - t_seg
+            u_mark = self._bt_upload_s
             with self.tracer.span("serve_tick", step=self._tick_no) as sp:
                 t0 = time.monotonic()
                 if spec:
@@ -1283,9 +1431,14 @@ class Engine:
                     toks, fins = self._tick_device()
                 dur = time.monotonic() - t0
                 sp.set(active=self.n_active)
+            # the device call's wall splits into the host->device table
+            # upload (when the table went stale) and dispatch+wait
+            seg["bt_upload"] = self._bt_upload_s - u_mark
+            seg["device"] = max(0.0, dur - seg["bt_upload"])
             emitted = 0
             slot_ticks = 0
             tnow = time.monotonic()
+            j_mark, s_mark = self._journal_s, self._sink_s
             for s, req in enumerate(self._slots):
                 if req is None:
                     continue
@@ -1326,12 +1479,44 @@ class Engine:
                 if fin_slot:
                     self._on_finished(req)
                     self._free_slot(s)
+            # accept host path: token routing + gap netting, minus the
+            # journal/sink writes _emit charged to their own segments
+            seg["accept"] = max(0.0, (time.monotonic() - tnow)
+                                - (self._journal_s - j_mark)
+                                - (self._sink_s - s_mark))
             self.metrics.on_tick(dur, emitted, slot_ticks)
             self._tick_no += 1
             if self.cfg.snapshot_every \
                     and self._tick_no % self.cfg.snapshot_every == 0:
-                self.tracer.snapshot(self.metrics.reg, step=self._tick_no)
+                rss = hb_host_rss_mb()
+                if rss is not None:
+                    # a gauge SERIES across snapshots — doctor reads the
+                    # trend for its host-leak warning
+                    self.metrics.reg.gauge("host_rss_mb").set(rss)
+                self.tracer.snapshot(self.metrics.reg, step=self._tick_no,
+                                     tickprof=self.tickprof.snapshot())
 
+        # compile ledger: 4 host-int reads per step. Any growth after
+        # warmup is a broken invariant — count it, name the executable,
+        # and leave churn context (what shape work just ran) for doctor
+        growth = self.ledger.check(self.compile_stats())
+        if growth:
+            self.metrics.on_recompile(
+                sum(g["after"] - g["before"] for g in growth))
+            for g in growth:
+                ctx = dict(tick=self._tick_no, active=self.n_active,
+                           queue=len(self.queue),
+                           last_prefill_bucket=self._last_prefill_bucket)
+                self.tracer.event("recompile_after_warmup",
+                                  executable=g["executable"],
+                                  before=g["before"], after=g["after"],
+                                  **ctx)
+                self.flight.note("recompile_after_warmup",
+                                 executable=g["executable"], **ctx)
+
+        seg["journal"] = self._journal_s - j_start
+        seg["sink"] = self._sink_s - s_start
+        t_seg = time.monotonic()
         self.metrics.observe_state(
             len(self.queue), self.n_active, self.cfg.slots)
         self.metrics.observe_cache(
@@ -1342,6 +1527,12 @@ class Engine:
                      active=self.n_active, queue=len(self.queue),
                      **({"alerts": self.slo.active_names()}
                         if self.slo is not None else {}))
+        seg["slo"] = time.monotonic() - t_seg
+        self.tickprof.record(self._tick_no, seg,
+                             time.monotonic() - p_start)
+        if self.flight.due(self._tick_no):
+            self.flight.spill("periodic", self._flight_payload(),
+                              tick=self._tick_no)
         return emissions
 
     def run(
@@ -1396,6 +1587,12 @@ class Engine:
                     time.sleep(idle_sleep_s)
                     continue
                 self.step()
+        except BaseException as e:
+            # the flight record IS the post-mortem: spill before the
+            # exception unwinds the process so doctor can cite the
+            # final ticks even when nothing catches it upstream
+            self.flight_spill("fatal_exception", error=repr(e)[:200])
+            raise
         finally:
             summary = self.metrics.summary()
             self.tracer.snapshot(self.metrics.reg, step=self._tick_no)
@@ -1409,6 +1606,7 @@ class Engine:
                 preempted=summary["preempted"],
                 alerts_raised=summary["alerts_raised"],
             )
+            self.flight_spill("serve_end")
             # the file holds only the LAST beat, so the terminal pulse
             # repeats the occupancy payload — a watcher reading a
             # "done" heartbeat still sees what the loop drained to

@@ -27,6 +27,24 @@ from hyperion_tpu.obs.registry import percentile
 from hyperion_tpu.obs.timeline import PHASES, cohort_dominant
 from hyperion_tpu.serve.queue import Request
 
+# THE serving-row vocabulary: every key a `run_load` report carries
+# that `obs diff`'s normalize() may consume. `scripts/check_diff_gates.py`
+# cross-checks the gated metric names against this tuple so a gate can
+# never outlive (or precede) the emitter that feeds it.
+SERVING_REPORT_KEYS = (
+    "requests", "completed", "rejected", "timed_out", "reject_rate",
+    "tokens", "tokens_per_s", "ttft_p50_ms", "ttft_p99_ms",
+    "e2e_p50_ms", "e2e_p99_ms", "elapsed_s", "arrival_rate_hz", "slots",
+    "shared_prefix_tokens", "prefix_hit_rate", "prefill_tokens_saved",
+    "preempted", "cow_copies", "blocks_in_use", "hbm_per_req_mb",
+    "accept_rate", "tokens_per_tick", "spec_drafted", "spec_accepted",
+    "spec_rejected", "shed", "brownout_clamped", "shed_rate",
+    "clamp_rate",
+    *(f"{p}_p99_ms" for p in PHASES),
+    "dominant_phase_p99", "ttft_p99_windowed_ms", "tpot_p99_windowed_ms",
+    "alerts_raised", "alerts_active", "recompiles",
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class LoadSpec:
@@ -215,6 +233,9 @@ def run_load(engine, spec: LoadSpec) -> dict:
         "tpot_p99_windowed_ms": _win_p99(engine, "tpot_ms"),
         "alerts_raised": cache.get("alerts_raised", 0),
         "alerts_active": cache.get("alerts_active", 0),
+        # compile ledger (obs/ledger.py): post-warmup jit-cache growth
+        # during the run — `obs diff` pins this at zero (ZERO_PINNED)
+        "recompiles": cache.get("recompiles", 0),
     }
 
 

@@ -56,7 +56,11 @@ class ServeMetrics:
                      # speculative decoding (serve/draft.py + the
                      # engine's spec tick): drafted = accepted+rejected
                      "serve_spec_drafted", "serve_spec_accepted",
-                     "serve_spec_rejected"):
+                     "serve_spec_rejected",
+                     # compile ledger (obs/ledger.py): pinned at zero
+                     # by the obs diff gate — any value > 0 is a broken
+                     # recompile-free invariant
+                     "serve_recompiles"):
             self.reg.counter(name)
         self._spec_drafted = 0
         self._spec_accepted = 0
@@ -77,6 +81,11 @@ class ServeMetrics:
 
     def on_timeout(self) -> None:
         self.reg.counter("serve_timed_out").inc()
+
+    def on_recompile(self, n: int = 1) -> None:
+        """Post-warmup jit-cache growth (compile ledger `check`): n new
+        executables appeared after the baseline was pinned."""
+        self.reg.counter("serve_recompiles").inc(n)
 
     # ------------------------------------------------- per-request SLOs
 
@@ -294,6 +303,8 @@ class ServeMetrics:
             "spec_rejected": int(c.get("serve_spec_rejected", 0)),
             "accept_rate": g.get("serve_spec_accept_rate"),
             "tokens_per_tick": g.get("serve_tokens_per_tick"),
+            # compile ledger (obs/ledger.py): the zero-pinned diff gate
+            "recompiles": int(c.get("serve_recompiles", 0)),
         }
 
 

@@ -66,6 +66,7 @@ from pathlib import Path
 
 from hyperion_tpu.obs import slo as slo_mod
 from hyperion_tpu.obs.export import DEFAULT_WINDOW_S
+from hyperion_tpu.obs.heartbeat import host_rss_mb
 from hyperion_tpu.serve.client import TERMINAL_EVENTS, ServeClient
 from hyperion_tpu.serve.metrics import RouterMetrics
 from hyperion_tpu.serve.queue import (
@@ -471,6 +472,9 @@ class Router:
             "replicas": reps,
             "metrics": self.metrics.reg.snapshot(),
             "windows": self.metrics.reg.windowed_snapshot(window_s),
+            # host memory only: the router holds no params and no KV
+            # pool, but its RSS still belongs on the obs top board
+            "memory": {"rss_mb": host_rss_mb()},
         }
 
     def _sweep_fleet_alerts(self) -> list[str]:

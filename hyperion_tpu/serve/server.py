@@ -637,6 +637,8 @@ def main(argv=None) -> int:
             slo_slow_s=args.slo_slow_s,
         ),
         tracer=tracer, heartbeat=hb, chaos=chaos, journal=journal,
+        flight_path=(hb.path.parent / "flight.json" if hb.enabled
+                     else None),
     )
     hb.pulse(phase="warmup")
     warm = [int(x) for x in args.warmup_lens.split(",") if x.strip()]
@@ -655,7 +657,8 @@ def main(argv=None) -> int:
 
         exporter = MetricsExporter(exposition_path(hb.path),
                                    engine.exposition,
-                                   label="serve-obs").start()
+                                   label="serve-obs",
+                                   control_fn=engine.control).start()
 
     # graceful drain: first SIGTERM/SIGINT closes the queue and lets
     # in-flight work finish under --drain-timeout; a second one stops
@@ -670,6 +673,14 @@ def main(argv=None) -> int:
             print(f"[serve] signal {signum}: draining (timeout "
                   f"{args.drain_timeout:.0f}s; signal again to stop "
                   "now)", file=sys.stderr)
+            # spill the flight record NOW: if the drain never finishes
+            # (hard stop, wedged device) the post-mortem still has the
+            # final ticks. Host-only dict/file work — signal-safe
+            # enough for a post-mortem artifact.
+            try:
+                engine.flight_spill("sigterm", signum=int(signum))
+            except Exception:  # noqa: BLE001 — never die in a handler
+                pass
         drain_evt.set()
 
     for sig in (signal.SIGTERM, signal.SIGINT):

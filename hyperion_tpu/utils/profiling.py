@@ -13,6 +13,7 @@ in TensorBoard/XProf, which is how real TPU perf work is done.
 from __future__ import annotations
 
 import contextlib
+import threading
 from pathlib import Path
 
 import jax
@@ -37,3 +38,42 @@ def capture(trace_dir: str | Path | None):
 def annotate(name: str):
     """Named sub-region inside a capture (shows as a span in the trace)."""
     return jax.profiler.TraceAnnotation(name)
+
+
+# on-demand tracing (the `obs profile` control verb): one trace at a
+# time per process — jax.profiler is a process-global singleton
+_TRACE_LOCK = threading.Lock()
+_TRACE_ACTIVE: list[str] = []
+
+
+def on_demand_trace(out_dir: str | Path, seconds: float) -> dict:
+    """Bracket `jax.profiler.start_trace`/`stop_trace` around a timer:
+    the caller (a live serving loop answering its exposition socket)
+    returns immediately with `{"status": "started"}` while a daemon
+    timer stops the trace after `seconds`. Degrades to a structured
+    answer — never an exception — on backends without profiler support
+    (`"unsupported"`) or when a trace is already running (`"busy"`)."""
+    seconds = max(0.1, min(float(seconds), 600.0))
+    out = str(out_dir)
+    with _TRACE_LOCK:
+        if _TRACE_ACTIVE:
+            return {"status": "busy", "dir": _TRACE_ACTIVE[0]}
+        try:
+            Path(out).mkdir(parents=True, exist_ok=True)
+            jax.profiler.start_trace(out)
+        except Exception as e:  # noqa: BLE001 — answer, don't raise
+            return {"status": "unsupported", "error": repr(e)[:300]}
+        _TRACE_ACTIVE.append(out)
+
+    def _stop():
+        with _TRACE_LOCK:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            _TRACE_ACTIVE.clear()
+
+    t = threading.Timer(seconds, _stop)
+    t.daemon = True
+    t.start()
+    return {"status": "started", "dir": out, "seconds": seconds}

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Diff-gate drift guard.
+
+`obs diff` gates the metric names in `obs/diff.py:METRICS`, and every
+one of them must be PRODUCIBLE from something the emitters actually
+write — a bench.py headline line, its `serving`/`serving_scale`/
+`input_pipeline` rows, a trainer summary, or `obs summarize --json`.
+A gate whose emitter key was renamed (or never existed) is worse than
+no gate: it silently drops out of every diff table and the regression
+it was supposed to catch sails through as "nothing comparable".
+
+This guard feeds `normalize()` one synthetic document that carries
+every emitter surface — the serving row uses the canonical
+`loadgen.SERVING_REPORT_KEYS` vocabulary, so a loadgen key rename
+breaks the build here instead of in a quarterly diff archaeology — and
+fails when any gated name is not produced (an ORPHANED gate), or when
+a zero-pinned name is not gated at all.
+
+    python scripts/check_diff_gates.py
+
+Exit 0: every gate reachable. Exit 1: orphaned gates (named on
+stderr). Host-only imports (obs/diff.py, serve/loadgen.py) — no jax,
+no devices; tier-1 runs this via tests/test_obs_live.py.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# run from anywhere: scripts/, not the repo root, is sys.path[0] — add
+# the root so hyperion_tpu imports
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hyperion_tpu.obs.diff import METRICS, ZERO_PINNED, normalize
+from hyperion_tpu.serve.loadgen import SERVING_REPORT_KEYS
+
+# the serving_scale row's keys are hardcoded in bench.py
+# `_child_serving_scale` (there is no shared vocabulary module for the
+# router probe); mirror them here so a rename there orphans the gate
+# loudly
+SERVING_SCALE_KEYS = ("tokens_per_s", "scaleup", "fairness",
+                      "affinity_hit_rate")
+
+
+def synthetic_doc() -> dict:
+    """One document exercising every `normalize()` surface with the
+    keys the real emitters write."""
+    return {
+        # obs summarize --json
+        "step_time_ms": {"p50": 1.0, "p99": 1.0, "mean": 1.0},
+        "tokens_per_s": 1.0, "samples_per_s": 1.0, "mfu": 1.0,
+        "hbm_peak_mb": 1.0, "vs_baseline": 1.0,
+        # bench.py headline line + attached probe rows
+        "metric": "synthetic", "value": 1.0,
+        "extra": {"lm_step_ms": 1.0, "lm_tokens_per_s": 1.0},
+        "input_pipeline": {"sync_batches_per_s": 1.0,
+                           "prefetch_batches_per_s": 1.0},
+        "serving": {k: 1.0 for k in SERVING_REPORT_KEYS},
+        "serving_scale": {k: 1.0 for k in SERVING_SCALE_KEYS},
+        # trainer *_summary.json
+        "step_ms": 1.0, "peak_hbm_mb": 1.0,
+    }
+
+
+def orphaned_gates() -> list[str]:
+    """Gated metric names `normalize()` cannot produce from any known
+    emitter vocabulary (sorted; empty = healthy)."""
+    producible = set(normalize(synthetic_doc()))
+    return sorted(set(METRICS) - producible)
+
+
+def main(argv: list[str] | None = None) -> int:
+    orphans = orphaned_gates()
+    unpinned = sorted(set(ZERO_PINNED) - set(METRICS))
+    if orphans:
+        print("check_diff_gates: FAIL — gated but unproducible "
+              f"metric(s): {', '.join(orphans)} — the emitter key was "
+              "renamed or never wired into obs/diff.py normalize()",
+              file=sys.stderr)
+    if unpinned:
+        print("check_diff_gates: FAIL — ZERO_PINNED name(s) not in "
+              f"METRICS: {', '.join(unpinned)}", file=sys.stderr)
+    if orphans or unpinned:
+        return 1
+    print(f"check_diff_gates: OK — {len(METRICS)} gated metric(s), "
+          "all producible from emitter vocabularies")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

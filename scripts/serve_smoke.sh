@@ -184,6 +184,26 @@ print(f"[serve_smoke] OK: kill-and-resume — {len(got)} tokens exactly "
       "run, replay visible on the stream")
 PY
 
+# the crash drill must leave a flight record next to the heartbeat:
+# the crashed life spilled its tick ring periodically (os._exit gives
+# no exit hook), and the replay life closed with a serve_end spill —
+# either way the post-mortem artifact exists and is well-formed
+python - "$WORK/flight.json" <<'PY'
+import json
+import sys
+
+from hyperion_tpu.obs.tickprof import FLIGHT_SCHEMA, flight_final_tick
+
+flight = json.load(open(sys.argv[1]))
+assert flight.get("v") == FLIGHT_SCHEMA, flight.get("v")
+assert flight.get("reason"), "flight record carries no spill reason"
+assert isinstance(flight.get("ticks"), list), "flight record has no tick ring"
+final = flight_final_tick(flight)
+assert final is not None, "flight record names no final tick"
+print(f"[serve_smoke] OK: flight record after crash drill — last spill "
+      f"reason={flight['reason']!r} at tick {final}")
+PY
+
 # 7. replica-tier round trip: `hyperion route` over 2 supervised
 #    replicas; replica 0 crashes HARD mid-stream (chaos crash@tick=2)
 #    while requests are in flight. The router fails over in-flight
@@ -372,9 +392,20 @@ assert rows["router"]["source"] == "socket", rows["router"]
 for r in live:
     assert r["source"] == "socket" and r["occupancy"] is not None, r
     assert r["ttft_p99_ms"] is not None, r
+# the introspection-plane columns ride the stable row schema: every
+# row carries the keys, and a live engine row's dominant segment (when
+# present) must use the tickprof vocabulary — drift-guarded against
+# the module, not a string copy
+from hyperion_tpu.obs.tickprof import SEGMENTS
+for r in doc["rows"]:
+    assert "dominant_segment" in r and "rss_mb" in r, r
+for r in live:
+    assert r["dominant_segment"] in (None, "other", *SEGMENTS), r
+    assert isinstance(r["rss_mb"], (int, float)), r
 print("[serve_smoke] OK: obs top — router + 2 replica rows live off "
       "the exposition sockets (windowed ttft p99s "
-      f"{[r['ttft_p99_ms'] for r in live]} ms)")
+      f"{[r['ttft_p99_ms'] for r in live]} ms, dominant segments "
+      f"{[r['dominant_segment'] for r in live]})")
 PY
 
 kill -TERM "$ROUTE_PID" 2>/dev/null || true

@@ -296,6 +296,82 @@ class TestSpeculationIncident:
         assert "serve speculation" not in doctor.render_markdown(d)
 
 
+def write_rss_run(path, run: str, series):
+    """A finished serve-shaped run whose snapshots carry the host RSS
+    gauge as a SERIES — the evidence `doctor` reads for the host-leak
+    trend."""
+    clk, wall = FakeClock(100.0), FakeClock(1_000.0)
+    t = Tracer(path, run=run, proc=0, clock=clk, wall=wall)
+    t.event("serve_start")
+    for i, mb in enumerate(series):
+        reg = MetricsRegistry()
+        reg.counter("serve_ticks").inc(10 * (i + 1))
+        reg.gauge("queue_depth").set(0.0)
+        reg.gauge("host_rss_mb").set(mb)
+        t.snapshot(reg, step=10 * (i + 1))
+        clk.advance(1.0)
+        wall.advance(1.0)
+    t.event("serve_end")
+    t.close()
+
+
+class TestRssTrend:
+    """`obs doctor` on the host-memory ledger: `ru_maxrss` is a
+    high-water mark, so the leak signal is a peak STILL RISING at the
+    newest snapshots after a material climb — plateaued-after-warmup
+    (the normal shape) must stay quiet."""
+
+    def test_monotonic_climb_is_warned(self, tmp_path):
+        write_rss_run(tmp_path / "telemetry.jsonl", "r1",
+                      [400.0, 440.0, 480.0, 520.0])
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["verdict"] == "healthy"
+        assert d["rss_trend"] == {"first_mb": 400.0, "last_mb": 520.0,
+                                  "samples": 4}
+        assert d["rss_warning"] is not None
+        assert "host RSS climbing monotonically" in d["reason"]
+        md = doctor.render_markdown(d)
+        assert "host RSS" in md and "**climbing**" in md
+
+    def test_plateaued_rss_stays_quiet(self, tmp_path):
+        # material climb, but the peak froze over the last snapshots:
+        # warmup growth, not a leak
+        write_rss_run(tmp_path / "telemetry.jsonl", "r1",
+                      [400.0, 520.0, 520.0, 520.0])
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["rss_warning"] is None
+        assert "climbing" not in d["reason"]
+        # the evidence row still renders, unflagged
+        md = doctor.render_markdown(d)
+        assert "host RSS" in md and "**climbing**" not in md
+
+    def test_short_series_makes_no_claim(self, tmp_path):
+        # two points cannot distinguish warmup from leak
+        write_rss_run(tmp_path / "telemetry.jsonl", "r1", [400.0, 900.0])
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["rss_trend"]["samples"] == 2
+        assert d["rss_warning"] is None
+
+    def test_no_gauge_means_no_row(self, tmp_path):
+        write_run(tmp_path / "telemetry.jsonl", "r1", 10.0)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["rss_trend"] is None
+        assert "host RSS" not in doctor.render_markdown(d)
+
+    def test_live_heartbeat_pulse_carries_rss(self, tmp_path):
+        """Satellite contract: every beat carries the process RSS (via
+        getrusage — no new deps), and the tolerant reader passes it
+        through untouched."""
+        from hyperion_tpu.obs.heartbeat import Heartbeat, host_rss_mb
+
+        hb = Heartbeat(tmp_path / "heartbeat.json", run="r1", every=1)
+        hb.pulse(step=1, phase="serve")
+        back = read_heartbeat(tmp_path / "heartbeat.json")
+        assert isinstance(back["rss_mb"], (int, float))
+        assert back["rss_mb"] > 0
+        assert host_rss_mb() > 0
+
+
 # -------------------------------------------------- telemetry contract
 
 

@@ -611,3 +611,245 @@ class TestAlertConsumers:
                     "comparable_metrics"):
             assert key in doc
         assert doc["regressions"] == ["step_time_mean_ms"]
+
+
+# --------------------------- introspection plane (PR-13, host-only)
+
+
+class TestTickProfiler:
+    def test_snapshot_dominates_and_derives_other(self):
+        from hyperion_tpu.obs.tickprof import TickProfiler
+
+        clk = FakeClock()
+        tp = TickProfiler(wall=clk)
+        for i in range(4):
+            tp.record(i, {"device": 0.006, "journal": 0.002}, 0.010)
+            clk.advance(1.0)
+        snap = tp.snapshot(window_s=60.0, now=clk.t)
+        assert snap["ticks"] == 4 and snap["dominant"] == "device"
+        assert snap["segments"]["device"]["frac"] == pytest.approx(0.6)
+        # unattributed host time surfaces as "other", never vanishes
+        assert snap["segments"]["other"]["s"] == pytest.approx(0.008)
+        assert snap["total_s"] == pytest.approx(0.040)
+
+    def test_window_cut_and_tail_bound(self):
+        from hyperion_tpu.obs.tickprof import TickProfiler
+
+        clk = FakeClock()
+        tp = TickProfiler(capacity=8, wall=clk)
+        for i in range(20):
+            tp.record(i, {"slo": 0.001}, 0.001)
+            clk.advance(10.0)
+        # ring bounded at capacity, tail bounded at n
+        assert len(tp.tail(100)) == 8
+        assert [r["tick"] for r in tp.tail(3)] == [17, 18, 19]
+        # only the last 25s of records land in the window
+        snap = tp.snapshot(window_s=25.0, now=clk.t)
+        assert snap["ticks"] == 2
+        assert snap["dominant"] == "slo"
+
+    def test_empty_snapshot_is_nulls_not_crashes(self):
+        from hyperion_tpu.obs.tickprof import TickProfiler
+
+        snap = TickProfiler().snapshot()
+        assert snap["ticks"] == 0 and snap["dominant"] is None
+        assert snap["dominant_frac"] is None and snap["segments"] == {}
+
+
+class TestFlightRecorder:
+    def test_first_spill_due_then_cadence(self, tmp_path):
+        from hyperion_tpu.obs.tickprof import FlightRecorder
+
+        fr = FlightRecorder(tmp_path / "flight.json", spill_every=16)
+        assert fr.due(2)  # a crash at tick 2 must still find evidence
+        fr.spill("periodic", {"phase": "serve"}, tick=2)
+        assert not fr.due(10) and not fr.due(17)
+        assert fr.due(18)
+
+    def test_spill_round_trip_and_final_tick(self, tmp_path):
+        from hyperion_tpu.obs.tickprof import (
+            FLIGHT_SCHEMA,
+            FlightRecorder,
+            flight_final_tick,
+            read_flight,
+        )
+
+        fr = FlightRecorder(tmp_path / "flight.json", run="serve_x")
+        fr.note("recompile_after_warmup", executable="prefill")
+        fr.spill("sigterm", {"ticks": [{"tick": 40}, {"tick": 41}]},
+                 tick=41)
+        doc = read_flight(tmp_path / "flight.json")
+        assert doc["v"] == FLIGHT_SCHEMA and doc["run"] == "serve_x"
+        assert doc["reason"] == "sigterm" and doc["spills"] == 1
+        assert doc["events"][0]["name"] == "recompile_after_warmup"
+        assert flight_final_tick(doc) == 41
+        # no spill tick stamp: the newest ring entry's tick answers
+        assert flight_final_tick({"ticks": [{"tick": 7}]}) == 7
+        assert flight_final_tick({}) is None
+
+    def test_null_recorder_and_unreadable_file(self, tmp_path):
+        from hyperion_tpu.obs.tickprof import (
+            null_flight_recorder,
+            read_flight,
+        )
+
+        fr = null_flight_recorder()
+        fr.note("x")
+        fr.spill("periodic", {"a": 1}, tick=1)  # accepted, writes nothing
+        assert not fr.enabled and not fr.due(1)
+        assert read_flight(tmp_path / "absent.json") is None
+        bad = tmp_path / "torn.json"
+        bad.write_text("{not json")
+        assert read_flight(bad) is None
+
+    def test_io_failure_degrades_not_raises(self, tmp_path):
+        from hyperion_tpu.obs.tickprof import FlightRecorder
+
+        blocker = tmp_path / "blocker"
+        blocker.write_text("")  # a FILE where the parent dir must go
+        fr = FlightRecorder(blocker / "flight.json")
+        fr.spill("periodic", {}, tick=1)
+        assert not fr.enabled  # degraded, process unharmed
+
+
+class TestCompileLedger:
+    def test_growth_reports_once_and_counts(self):
+        from hyperion_tpu.obs.ledger import CompileLedger
+
+        led = CompileLedger()
+        base = {"tick_executables": 1, "prefill_executables": 2}
+        # no-op until baselined: an unwarmed engine has no invariant
+        assert led.check({"tick_executables": 9}) == []
+        led.set_baseline(base)
+        assert led.check(base) == []
+        grown = {"tick_executables": 1, "prefill_executables": 3}
+        (g,) = led.check(grown)
+        assert g == {"executable": "prefill_executables", "before": 2,
+                     "after": 3}
+        assert led.recompiles == 1
+        # last-seen advanced: the same counts report nothing new
+        assert led.check(grown) == []
+        assert led.last_seen["prefill_executables"] == 3
+
+    def test_warmup_record_shape(self):
+        from hyperion_tpu.obs.ledger import CompileLedger
+
+        led = CompileLedger()
+        rec = led.record_warmup({"tick_executables": 1},
+                                compile_s={"tick": 1.25},
+                                costs={"tick_flops": 3.0}, total_s=2.0)
+        assert rec["stats"] == {"tick_executables": 1}
+        assert rec["compile_s"]["tick"] == 1.25
+        assert rec["costs"]["tick_flops"] == 3.0 and rec["total_s"] == 2.0
+        assert led.warmup is rec
+
+
+class TestDiffRecompileGate:
+    def _norm(self, recompiles):
+        from hyperion_tpu.obs import diff as obs_diff
+
+        doc = {"metric": "matmul", "value": 1.0,
+               "serving": {"tokens_per_s": 100.0,
+                           "recompiles": recompiles}}
+        return {"label": f"r{recompiles}",
+                "metrics": obs_diff.normalize(doc)}
+
+    def test_zero_pinned_regresses_off_zero(self):
+        from hyperion_tpu.obs import diff as obs_diff
+
+        # the distinctive behavior: a 0 base is NOT skipped for this
+        # metric — 0 -> 1 is a broken invariant, threshold be damned
+        d = obs_diff.diff(self._norm(0), self._norm(1), threshold=0.10)
+        assert "serve_recompiles" in d["regressions"]
+        (row,) = [r for r in d["rows"] if r["metric"] == "serve_recompiles"]
+        assert row["delta_pct"] is None  # no percent delta at a 0 base
+        assert "serve_recompiles" in obs_diff.ZERO_PINNED
+        # renders without a formatting crash on the None delta
+        assert "serve_recompiles" in obs_diff.render_markdown(d)
+
+    def test_zero_to_zero_is_healthy(self):
+        from hyperion_tpu.obs import diff as obs_diff
+
+        d = obs_diff.diff(self._norm(0), self._norm(0))
+        assert "serve_recompiles" not in d["regressions"]
+        # the row still shows up: the gate is visibly ARMED, not absent
+        assert any(r["metric"] == "serve_recompiles" for r in d["rows"])
+        # and going back DOWN is an improvement
+        d = obs_diff.diff(self._norm(2), self._norm(0))
+        assert "serve_recompiles" not in d["regressions"]
+
+
+class TestDiffGatesGuard:
+    """scripts/check_diff_gates.py — a gated metric nobody emits is
+    worse than no gate (it silently drops out of every diff table)."""
+
+    def _guard(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "check_diff_gates",
+            Path(__file__).parent.parent / "scripts"
+            / "check_diff_gates.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_current_gates_all_producible(self):
+        assert self._guard().main([]) == 0
+
+    def test_orphaned_gate_fails(self, monkeypatch, capsys):
+        from hyperion_tpu.obs import diff as obs_diff
+
+        mod = self._guard()
+        monkeypatch.setitem(obs_diff.METRICS, "serve_never_emitted",
+                            "lower")
+        assert mod.main([]) == 1
+        assert "serve_never_emitted" in capsys.readouterr().err
+
+
+class TestExpositionControl:
+    def test_control_round_trip_and_bare_clients(self, tmp_path):
+        from hyperion_tpu.obs.export import request_control
+
+        calls = []
+
+        def control(req):
+            calls.append(req)
+            return {"status": "started", "dir": req.get("out")}
+
+        sock = tmp_path / "obs.sock"
+        with MetricsExporter(sock, lambda window_s=60.0: {"phase": "x"},
+                             control_fn=control):
+            # fast path unchanged: the newline probe gets exposition
+            doc = read_exposition(sock)
+            assert doc["kind"] == "exposition" and doc["phase"] == "x"
+            # a JSON request line routes to the control fn
+            res = request_control(sock, {"cmd": "profile", "out": "d"})
+            assert res["kind"] == "control" and res["status"] == "started"
+            assert calls == [{"cmd": "profile", "out": "d"}]
+            # garbage on the request line degrades to exposition,
+            # never an error (nc -U stays a valid client)
+            import socket as socket_mod
+
+            s = socket_mod.socket(socket_mod.AF_UNIX,
+                                  socket_mod.SOCK_STREAM)
+            s.connect(str(sock))
+            s.sendall(b"not json\n")
+            data = b""
+            while not data.endswith(b"\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+            s.close()
+            assert json.loads(data)["kind"] == "exposition"
+
+    def test_control_request_without_control_fn_gets_exposition(
+            self, tmp_path):
+        from hyperion_tpu.obs.export import request_control
+
+        sock = tmp_path / "obs.sock"
+        with MetricsExporter(sock, lambda window_s=60.0: {"phase": "x"}):
+            res = request_control(sock, {"cmd": "profile"})
+            assert res["kind"] == "exposition" and res["phase"] == "x"
+

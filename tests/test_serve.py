@@ -1720,3 +1720,221 @@ class TestSLOLivePlane:
         assert isinstance(doc["blocks_in_use"], int)
         # answering the socket traced nothing and touched no jit cache
         assert eng.compile_stats() == stats0
+
+
+# ---------------------------------- introspection plane (PR-13)
+
+
+class TestIntrospection:
+    """Compile ledger, host-tick profiler, memory ledger, and the
+    exposition control verb on a LIVE engine — everything on the
+    suite's already-compiled shapes except the one deliberately
+    shape-churned engine that PAYS for its recompile to prove the
+    ledger catches it."""
+
+    def test_concurrent_pollers_race_free_and_compile_flat(
+            self, llama, tmp_path):
+        """Satellite (d): N threaded `obs top`-style pollers against a
+        stepping engine — every answer complete and well-formed, zero
+        new jit compiles from answering."""
+        import threading
+
+        from hyperion_tpu.obs import top as top_mod
+        from hyperion_tpu.obs.export import MetricsExporter
+
+        eng = _engine(llama)
+        eng.warmup([8, 16])
+        stats0 = eng.compile_stats()
+        for i, p in enumerate(_prompts([5, 9, 4, 6], seed=21)):
+            eng.submit(Request(prompt_ids=p, max_new_tokens=6,
+                               id=f"poll{i}"))
+        rows: list[dict] = []
+        errors: list[str] = []
+        stop = threading.Event()
+
+        def poll():
+            try:
+                while not stop.is_set():
+                    row = top_mod.sample("process", tmp_path,
+                                         timeout_s=2.0)
+                    rows.append(row)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(repr(e))
+
+        with MetricsExporter(tmp_path / "obs.sock", eng.exposition,
+                             control_fn=eng.control):
+            threads = [threading.Thread(target=poll) for _ in range(4)]
+            for t in threads:
+                t.start()
+            _drain(eng)
+            for _ in range(8):      # a few idle ticks under fire too
+                eng.step()
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not errors, errors
+        assert not any(t.is_alive() for t in threads)
+        assert rows, "pollers never sampled"
+        live = [r for r in rows if r["source"] == "socket"]
+        assert live, rows[:3]
+        for r in live:              # the stable row schema held under
+            assert set(top_mod.ROW_KEYS) <= set(r)   # concurrency
+            assert r["state"] == "live"
+        # the introspection columns answer off the live payload
+        assert any(r["dominant_segment"] is not None for r in live)
+        assert all(isinstance(r["rss_mb"], (int, float)) for r in live)
+        # answering N pollers compiled nothing and recompiled nothing
+        assert eng.compile_stats() == stats0
+        assert eng.ledger.recompiles == 0
+
+    def test_exposition_carries_introspection_payload(
+            self, llama, tmp_path):
+        from hyperion_tpu.obs.tickprof import SEGMENTS
+
+        eng = _engine(llama)
+        eng.warmup([8])
+        eng.submit(Request(prompt_ids=_prompts([5], seed=22)[0],
+                           max_new_tokens=4, id="intro0"))
+        _drain(eng)
+        doc = eng.exposition()
+        tp = doc["tickprof"]
+        assert tp["ticks"] > 0 and tp["dominant"] in ("other", *SEGMENTS)
+        assert tp["segments"][tp["dominant"]]["frac"] > 0
+        mem = doc["memory"]
+        assert mem["param_bytes"] > 0 and mem["kv_pool_bytes"] > 0
+        assert mem["blocks_in_use_bytes"] == 0  # drained
+        assert isinstance(mem["rss_mb"], float) and mem["rss_mb"] > 0
+        comp = doc["compile"]
+        assert comp["recompiles"] == 0
+        assert comp["tick_executables"] >= 1
+        # the warmup ledger recorded per-executable compile wall time
+        led = eng.ledger.warmup
+        assert led and "tick" in led["compile_s"]
+        assert any(k.startswith("prefill_b") for k in led["compile_s"])
+
+    def test_shape_churn_raises_exactly_one_recompile_incident(
+            self, tmp_path):
+        """The acceptance drill: a deliberately shape-churned run (a
+        prompt outside the warmed bucket ladder, on a uniquely-
+        dimensioned model so the process-wide caches can't mask it)
+        raises exactly one `recompile_after_warmup` doctor incident
+        naming the executable."""
+        from hyperion_tpu.obs import doctor
+        from hyperion_tpu.obs.trace import Tracer
+
+        model = Llama(llama_tiny_config(vocab_size=97, max_len=64))
+        variables = {"params": model.init_params(jax.random.key(1),
+                                                 seq=8)}
+        tracer = Tracer(tmp_path / "telemetry.jsonl", run="churn")
+        eng = Engine(model, variables,
+                     EngineConfig(slots=2, max_len=48, eos_id=None),
+                     tracer=tracer)
+        eng.warmup([8])     # ladder stops at bucket 8 — deliberately
+        assert eng.ledger.recompiles == 0
+        # a 20-token prompt needs the UNWARMED 32 bucket (power-of-
+        # two ladder): this engine pays a prefill compile post-warmup,
+        # which is the invariant breach the ledger must catch
+        eng.submit(Request(prompt_ids=_prompts([20], seed=23,
+                                               vocab=97)[0],
+                           max_new_tokens=3, id="churn0"))
+        _drain(eng)
+        assert eng.ledger.recompiles == 1
+        assert eng.metrics.reg.snapshot()["counters"][
+            "serve_recompiles"] == 1
+        assert eng.metrics.summary()["recompiles"] == 1
+        tracer.close()
+        recs = [json.loads(line) for line in
+                (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+        events = [r for r in recs
+                  if r.get("name") == "recompile_after_warmup"]
+        assert len(events) == 1, events
+        assert events[0]["executable"] == "prefill_executables"
+        assert events[0]["last_prefill_bucket"] == 32
+        d = doctor.diagnose(tmp_path)
+        assert len(d["recompile_incidents"]) == 1
+        assert "recompile after warmup" in d["reason"]
+        assert "prefill_executables" in d["reason"]
+        assert "warmup ladder" in d["reason"]
+        md = doctor.render_markdown(d)
+        assert "broken invariant" in md
+
+    def test_slow_journal_named_dominant_host_segment(
+            self, llama, tmp_path):
+        """A seeded slow-journal run (fault callable sleeping inside
+        every append) must yield a doctor incident naming the journal
+        as the dominant host segment — not a vague 'host-bound'."""
+        from hyperion_tpu.obs import doctor
+        from hyperion_tpu.obs.trace import Tracer
+        from hyperion_tpu.serve.journal import RequestJournal
+
+        tracer = Tracer(tmp_path / "telemetry.jsonl", run="slowj")
+        journal = RequestJournal(tmp_path / "journal.jsonl",
+                                 fault=lambda tag: time.sleep(0.004))
+        model, variables = llama
+        eng = Engine(model, variables,
+                     EngineConfig(slots=3, max_len=48, eos_id=None,
+                                  snapshot_every=4),
+                     tracer=tracer, journal=journal)
+        eng.warmup([8, 16])
+        stats0 = eng.compile_stats()
+        for i, p in enumerate(_prompts([5, 9, 4], seed=24)):
+            eng.submit(Request(prompt_ids=p, max_new_tokens=14,
+                               id=f"slowj{i}"))
+        _drain(eng)
+        journal.close()
+        snap = eng.tickprof.snapshot()
+        assert snap["dominant"] == "journal", snap
+        assert snap["ticks"] >= 8
+        assert eng.compile_stats() == stats0
+        tracer.close()
+        d = doctor.diagnose(tmp_path)
+        assert d["host_segment_incidents"], d["tickprof"]
+        assert "host segment 'journal'" in d["reason"]
+        assert "slow disk" in d["reason"]
+        assert "host-bound" in doctor.render_markdown(d)
+
+    def test_profiled_run_compiles_nothing(self, llama, tmp_path):
+        """The acceptance criterion: `compile_stats()` flat across a
+        profiled run — bracketing jax.profiler around live ticks adds
+        zero executables (and degrades to a structured answer where
+        tracing is unsupported)."""
+        from hyperion_tpu.utils.profiling import on_demand_trace
+
+        eng = _engine(llama)
+        eng.warmup([8])
+        stats0 = eng.compile_stats()
+        res = on_demand_trace(tmp_path / "prof", 0.3)
+        assert res["status"] in ("started", "unsupported", "busy"), res
+        eng.submit(Request(prompt_ids=_prompts([5], seed=25)[0],
+                           max_new_tokens=5, id="prof0"))
+        _drain(eng)
+        if res["status"] == "started":
+            time.sleep(0.45)    # let the daemon timer stop the trace
+        assert eng.compile_stats() == stats0
+        assert eng.ledger.recompiles == 0
+
+    def test_profile_control_verb_answers(self, llama, tmp_path):
+        """`obs profile` end to end minus the CLI: the control request
+        through the exposition socket starts (or declines) a trace and
+        answers a status dict, never an error envelope."""
+        from hyperion_tpu.obs.export import MetricsExporter, request_control
+
+        eng = _engine(llama)
+        eng.warmup([8])
+        stats0 = eng.compile_stats()
+        sock = tmp_path / "obs.sock"
+        with MetricsExporter(sock, eng.exposition,
+                             control_fn=eng.control):
+            res = request_control(
+                sock, {"cmd": "profile", "seconds": 0.2,
+                       "out": str(tmp_path / "prof2")})
+            assert res["kind"] == "control"
+            assert res["status"] in ("started", "unsupported", "busy")
+            # a malformed control request answers an error dict
+            bad = request_control(sock, {"cmd": "profile"})
+            assert bad["status"] == "error" and "out" in bad["error"]
+            unknown = request_control(sock, {"cmd": "nope"})
+            assert unknown["status"] == "error"
+        if res["status"] == "started":
+            time.sleep(0.35)
+        assert eng.compile_stats() == stats0

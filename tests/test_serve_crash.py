@@ -524,3 +524,78 @@ class TestObsIntegration:
                   / "serve_smoke.sh").read_text()
         assert "--supervise" in script and "crash@tick" in script
         assert "--journal" in script
+
+
+# -------------------------------------------- flight-record post-mortem
+
+
+class TestFlightPostMortem:
+    """Flight recorder × doctor: a serve loop that died without a
+    terminal event must have its verdict cite the flight record's
+    final ticks — the only evidence of what the loop was doing."""
+
+    def _dead_stream(self, tmp_path, run="serve_fl"):
+        recs = [
+            {"v": 1, "kind": "event", "name": "serve_start", "run": run,
+             "proc": 0, "t_wall": 100.0, "t_mono": 1.0},
+        ]
+        for i in range(6):
+            recs.append({"v": 1, "kind": "span", "name": "serve_tick",
+                         "run": run, "proc": 0, "step": i,
+                         "t_wall": 100.0 + 0.1 * i,
+                         "t_mono": 1.0 + 0.1 * i, "dur_ms": 2.0})
+        # no serve_end: the loop died mid-flight
+        (tmp_path / "telemetry.jsonl").write_text(
+            "\n".join(json.dumps(r) for r in recs) + "\n")
+        return tmp_path
+
+    def test_hung_verdict_cites_flight_final_tick(self, tmp_path):
+        from hyperion_tpu.obs import doctor
+        from hyperion_tpu.obs.tickprof import FLIGHT_NAME, FLIGHT_SCHEMA
+
+        run = "serve_fl"
+        self._dead_stream(tmp_path, run)
+        flight = {
+            "v": FLIGHT_SCHEMA, "run": run, "pid": 4242,
+            "t_wall": 100.6, "reason": "periodic", "tick": 41,
+            "spills": 3, "active": 2, "queue": 5, "events": [],
+            "ticks": [{"tick": 40, "total": 0.002},
+                      {"tick": 41, "total": 0.002}],
+            "tickprof": {"dominant": "journal", "dominant_frac": 0.61,
+                         "ticks": 2},
+        }
+        (tmp_path / FLIGHT_NAME).write_text(json.dumps(flight))
+
+        d = doctor.diagnose(tmp_path, now=100.6 + 10_000)
+        assert d["verdict"] in ("hung", "crashed"), d["reason"]
+        fl = d["flight"]
+        assert fl and fl["final_tick"] == 41 and fl["spills"] == 3
+        assert "flight record: last spill at tick 41" in d["reason"]
+        assert "2 active + 5 queued" in d["reason"]
+        assert "dominant segment journal 61%" in d["reason"]
+        md = doctor.render_markdown(d)
+        assert "| flight record |" in md and "`journal`" in md
+
+    def test_other_runs_flight_record_is_ignored(self, tmp_path):
+        """A stale flight.json from an earlier run in the same dir must
+        not pollute this run's verdict (same run-filter contract as the
+        heartbeat)."""
+        from hyperion_tpu.obs import doctor
+        from hyperion_tpu.obs.tickprof import FLIGHT_NAME
+
+        self._dead_stream(tmp_path, "serve_fl")
+        (tmp_path / FLIGHT_NAME).write_text(json.dumps(
+            {"v": 1, "run": "somebody_else", "tick": 9, "reason": "x"}))
+        d = doctor.diagnose(tmp_path, now=110_000.0)
+        assert d["flight"] is None
+        assert "flight record" not in d["reason"]
+
+    def test_smoke_script_asserts_flight_and_dominant_segment(self):
+        """The CI satellite: serve_smoke.sh's kill drill must assert
+        flight.json lands, and its obs-top leg must check the
+        dominant-segment column."""
+        script = (Path(__file__).resolve().parents[1] / "scripts"
+                  / "serve_smoke.sh").read_text()
+        assert "flight.json" in script
+        assert "flight_final_tick" in script
+        assert "dominant_segment" in script
