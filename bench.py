@@ -371,6 +371,44 @@ def _child_serving() -> None:
         if label == "k4":
             report["accept_rate"] = r.get("accept_rate")
             report["tokens_per_tick"] = r.get("tokens_per_tick")
+
+    # ---- the @class dimension: the workload-isolation drill as a
+    # bench point — the SAME seeded shared-prefix workload with every
+    # 3rd request class=batch and one hostile long-prompt batch tenant
+    # riding along, chunked prefill on, the class-aware brownout armed.
+    # The verdict keys (interactive TTFT p99 while under attack, batch
+    # shed rate) ride the row TOP-LEVEL: they are what
+    # `serve_interactive_ttft_p99_ms` / `serve_batch_shed_rate` gate,
+    # measured where the hostile tenant actually runs.
+    cls_load = LoadSpec(n_requests=24, rate_hz=100.0,
+                        prompt_lens=(4, 8, 16), max_new=(4, 8, 12),
+                        vocab=cfg.vocab_size, seed=0,
+                        shared_prefix_tokens=shared,
+                        batch_every=3,
+                        adversary="oversize", adversary_every=6,
+                        adversary_prompt_len=96)
+    eng = Engine(
+        model, {"params": params},
+        EngineConfig(slots=4, max_len=128, eos_id=None,
+                     queue_capacity=8, prefill_budget=96,
+                     prefill_chunk=32,
+                     brownout=True, brownout_depth=6,
+                     batch_deadline_s=5.0),
+    )
+    eng.warmup([shared + p for p in cls_load.prompt_lens])
+    r = run_load(eng, cls_load)
+    report["class"] = {
+        key: r.get(key)
+        for key in ("tokens_per_s", "completed", "shed",
+                    "brownout_clamped", "recompiles", "ttft_p99_ms",
+                    *(f"{cls}_{k}" for cls in ("interactive", "batch")
+                      for k in ("ttft_p99_ms", "tpot_p99_ms",
+                                "completed", "shed", "shed_rate")))
+    }
+    report["class"]["compile"] = eng.compile_stats()
+    for key in ("interactive_ttft_p99_ms", "batch_shed_rate",
+                "interactive_shed", "batch_shed"):
+        report[key] = r.get(key)
     print(json.dumps(report))
 
 

@@ -100,13 +100,24 @@ METRICS: dict[str, str] = {
     # EXACTLY 0, so any increase is a regression regardless of the
     # percent threshold (see ZERO_PINNED below)
     "serve_recompiles": "lower",
+    # workload isolation (PR 14, the bench serving row's @class
+    # dimension): interactive TTFT p99 under a hostile mixed-class load
+    # is THE isolation promise — and batch sheds rising at the same
+    # offered load means the batch tier lost ground it used to hold.
+    # Both gated so neither tier can quietly pay for the other.
+    "serve_interactive_ttft_p99_ms": "lower",
+    "serve_batch_shed_rate": "lower",
 }
 
 # metrics whose healthy value is exactly zero: the percent-threshold
 # machinery is meaningless at a zero base (0 -> 1 is an infinite
 # increase), so any move OFF zero in the bad direction regresses —
 # these skip the zero-base bail-out in `diff()` instead of hiding in it
-ZERO_PINNED = frozenset({"serve_recompiles"})
+ZERO_PINNED = frozenset({"serve_recompiles",
+                         # the class probe's healthy batch shed rate IS
+                         # 0.0 — a zero-base skip would hide the exact
+                         # regression this gate exists for
+                         "serve_batch_shed_rate"})
 
 
 def _num(v) -> float | None:
@@ -182,7 +193,11 @@ def normalize(doc: dict) -> dict[str, float]:
                               ("accept_rate", "serve_accept_rate"),
                               ("tokens_per_tick",
                                "serve_tokens_per_tick"),
-                              ("recompiles", "serve_recompiles")):
+                              ("recompiles", "serve_recompiles"),
+                              ("interactive_ttft_p99_ms",
+                               "serve_interactive_ttft_p99_ms"),
+                              ("batch_shed_rate",
+                               "serve_batch_shed_rate")):
                 v = _num(srv.get(src))
                 if v is not None:
                     out[name] = v

@@ -608,6 +608,84 @@ def diagnose(
     if fleet_incidents:
         reason += "; fleet: " + "; ".join(fleet_incidents)
 
+    # Hostile-tenant attribution (PR 14): adversarial workload profiles
+    # tag their requests with a tenant label, and the engine's
+    # admit/shed events carry it through — so when a run degraded, the
+    # doctor can NAME the workload that drove it instead of describing
+    # anonymous pressure. Ranked by damage (sheds+rejects, then
+    # volume): the top row is the offender.
+    tenant_rows: dict[str, dict] = {}
+    for e in events:
+        t = e.get("tenant")
+        if not t:
+            continue
+        row = tenant_rows.setdefault(str(t), {
+            "tenant": str(t), "admitted": 0, "shed": 0, "rejected": 0,
+            "classes": set()})
+        if e.get("sla_class"):
+            row["classes"].add(str(e["sla_class"]))
+        if e.get("name") == "request_admitted":
+            row["admitted"] += 1
+        elif e.get("name") == "request_rejected":
+            row["shed" if e.get("shed") else "rejected"] += 1
+    tenants = sorted(tenant_rows.values(),
+                     key=lambda r: (-(r["shed"] + r["rejected"]),
+                                    -r["admitted"], r["tenant"]))
+    for r in tenants:
+        r["classes"] = sorted(r["classes"])
+    tenant_incidents: list[str] = []
+    if tenants:
+        top = tenants[0]
+        desc = f"{top['admitted']} admitted"
+        if top["shed"]:
+            desc += f", {top['shed']} shed"
+        if top["rejected"]:
+            desc += f", {top['rejected']} rejected"
+        hostile = bool(overload) or top["shed"] or top["rejected"]
+        tenant_incidents.append(
+            (f"tenant '{top['tenant']}' drove the pressure ({desc})"
+             if hostile else
+             f"tenant '{top['tenant']}' tagged traffic ({desc})"))
+        for r in tenants[1:]:
+            tenant_incidents.append(
+                f"tenant '{r['tenant']}': {r['admitted']} admitted, "
+                f"{r['shed']} shed, {r['rejected']} rejected")
+    if tenant_incidents and verdict in ("healthy", "running", "stalled",
+                                        "failed", "crashed", "hung"):
+        reason += "; tenants: " + "; ".join(tenant_incidents)
+
+    # Router-action narration (PR 14): the acting router leaves a
+    # telemetry trail (router_steer / router_scale / class_brownout) —
+    # the doctor rolls it into prose so "what did the fleet DO about
+    # the burn" is one read, not an event grep.
+    router_actions: list[str] = []
+    steers = [e for e in events if e.get("name") == "router_steer"]
+    if steers:
+        on = [e for e in steers if e.get("on")]
+        off = [e for e in steers if not e.get("on")]
+        reps = sorted({e.get("replica") for e in on})
+        router_actions.append(
+            f"steered interactive traffic off replica(s) "
+            f"{', '.join(str(i) for i in reps)} ({len(on)} steer(s), "
+            f"{len(off)} unsteer(s)"
+            + (" — still steered at the end" if len(on) > len(off)
+               else ", all reversed") + ")")
+    cbr = [e for e in events if e.get("name") == "class_brownout"]
+    if cbr:
+        ordered = sum(1 for e in cbr if e.get("active"))
+        router_actions.append(
+            f"batch-class brownout ordered {ordered}x, lifted "
+            f"{len(cbr) - ordered}x")
+    scales = [e for e in events if e.get("name") == "router_scale"]
+    if scales:
+        ups = sum(1 for e in scales if e.get("direction") == "up")
+        router_actions.append(
+            f"alert-driven scaling: {ups} standby spawn(s), "
+            f"{len(scales) - ups} retire(s)")
+    if router_actions and verdict in ("healthy", "running", "stalled",
+                                      "failed", "crashed", "hung"):
+        reason += "; router actions: " + "; ".join(router_actions)
+
     # Tail-attribution incidents (obs/timeline.py): the request-scoped
     # trace says WHERE the p99 went, so the doctor can name the FIX —
     # "raise --slots" and "raise --num-blocks" are different knobs a
@@ -775,6 +853,11 @@ def diagnose(
         "slo_incidents": slo_incidents,
         "fleet": fleet_rows,
         "fleet_incidents": fleet_incidents,
+        # workload-isolation plane (PR 14): who drove the pressure and
+        # what the acting router did about it
+        "tenants": tenants,
+        "tenant_incidents": tenant_incidents,
+        "router_actions": router_actions,
         "cache_pressure": cache_pressure,
         "spec_incidents": spec_issues,
         "overload": overload,
@@ -974,6 +1057,16 @@ def render_markdown(d: dict) -> str:
             f"pid {_fmt(row.get('pid'))}, attempt "
             f"{_fmt(row.get('attempt'))}{occ}, beat age "
             f"{_fmt(row.get('age_s'))} s{ej}){flag} |")
+    for i, row in enumerate(d.get("tenants") or []):
+        flag = (" — **offender**"
+                if i == 0 and (row["shed"] or row["rejected"]) else "")
+        cls = "/".join(row["classes"]) or "?"
+        lines.append(
+            f"| tenant `{row['tenant']}` | {cls}: "
+            f"admitted {row['admitted']}, shed {row['shed']}, "
+            f"rejected {row['rejected']}{flag} |")
+    for act in d.get("router_actions") or []:
+        lines.append(f"| router action | {act} |")
     for row in d.get("tail_attribution") or []:
         comps = ", ".join(f"{p} {v:.1f}"
                           for p, v in row["components_ms"].items() if v)

@@ -43,8 +43,8 @@ FLIGHT_NAME = "flight.json"
 # every recorded tick carries a subset of these keys, seconds each.
 # "other" is derived at snapshot time (total minus named segments) so
 # unattributed host time is visible instead of silently vanishing.
-SEGMENTS = ("queue_pop", "admit", "draft", "bt_upload", "device",
-            "accept", "journal", "sink", "slo")
+SEGMENTS = ("queue_pop", "admit", "chunk", "draft", "bt_upload",
+            "device", "accept", "journal", "sink", "slo")
 
 
 class TickProfiler:

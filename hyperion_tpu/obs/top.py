@@ -52,7 +52,11 @@ ROW_KEYS = ("name", "dir", "source", "state", "pid", "phase", "step",
             "alerts", "age_s", "restarts", "window_s",
             # introspection plane: the windowed dominant host segment
             # (obs/tickprof.py vocabulary) and host RSS in MB
-            "dominant_segment", "rss_mb")
+            "dominant_segment", "rss_mb",
+            # workload isolation (PR 14): per-SLO-class queue depth and
+            # what the self-operating layer is doing right now (engine:
+            # class brownout / chunking; router: steering / scaling)
+            "queue_interactive", "queue_batch", "act")
 
 
 def discover(base: str | Path) -> list[tuple[str, Path]]:
@@ -109,7 +113,33 @@ def _row_from_exposition(row: dict, exp: dict) -> dict:
     tp = exp.get("tickprof") or {}
     row["dominant_segment"] = tp.get("dominant")
     row["rss_mb"] = (exp.get("memory") or {}).get("rss_mb")
+    qbc = exp.get("queue_by_class") or {}
+    row["queue_interactive"] = qbc.get("interactive")
+    row["queue_batch"] = qbc.get("batch")
+    row["act"] = _act_cell(exp.get("act") or {})
     return row
+
+
+def _act_cell(act: dict) -> str | None:
+    """Compress the exposition's `act` payload into one cell — what the
+    self-operating layer is DOING, not just measuring: an engine under
+    a class brownout order or mid-chunked-prefill, a router steering
+    traffic or running a scaled fleet. None when the process predates
+    (or doesn't carry) the payload; '-' when it carries it and is
+    idle — the difference between "can't act" and "nothing to do"."""
+    if not act:
+        return None
+    bits: list[str] = []
+    if act.get("class_brownout"):
+        bits.append("cbrown")
+    if act.get("chunking"):
+        bits.append(f"chunk:{act['chunking']}")
+    steered = act.get("steered") or []
+    if steered:
+        bits.append("steer:" + ",".join(str(i) for i in steered))
+    if act.get("max_replicas"):
+        bits.append(f"fleet:{act.get('fleet')}/{act['max_replicas']}")
+    return "+".join(bits) or "-"
 
 
 def _row_from_heartbeat(row: dict, hb: dict | None, *, now: float,
@@ -173,10 +203,11 @@ def render(rows: list[dict], base: str, *, window_s: float,
     """One frame: fixed-width table, ANSI-colored states."""
     now = time.time() if now is None else now
     cols = [("process", 11), ("state", 12), ("pid", 7), ("phase", 10),
-            ("tick", 6), ("occ", 5), ("queue", 5), ("tok/s", 8),
+            ("tick", 6), ("occ", 5), ("queue", 5), ("q i/b", 6),
+            ("tok/s", 8),
             (f"ttft p99({window_s:.0f}s)", 14), ("blocks", 6),
             ("seg", 9), ("rss", 7),
-            ("brown", 5), ("alerts", 18), ("age", 5)]
+            ("brown", 5), ("act", 12), ("alerts", 18), ("age", 5)]
     head = " ".join(f"{n:<{w}}" for n, w in cols)
     lines = [
         f"obs top — {base} · {time.strftime('%H:%M:%S', time.localtime(now))}"
@@ -191,12 +222,17 @@ def render(rows: list[dict], base: str, *, window_s: float,
                if isinstance(r["ttft_p99_ms"], (int, float)) else "—")
         rss = (f"{r['rss_mb']:.0f}M"
                if isinstance(r["rss_mb"], (int, float)) else "—")
+        qib = ("—" if r["queue_interactive"] is None
+               and r["queue_batch"] is None
+               else f"{_fmt(r['queue_interactive'])}"
+                    f"/{_fmt(r['queue_batch'])}")
         cells = [r["name"], r["state"] or "?", _fmt(r["pid"]),
                  _fmt(r["phase"]), _fmt(r["step"]), occ,
-                 _fmt(r["queue"]), _fmt(r["tokens_per_s"]), p99,
+                 _fmt(r["queue"]), qib,
+                 _fmt(r["tokens_per_s"]), p99,
                  _fmt(r["blocks_in_use"]),
                  _fmt(r["dominant_segment"]), rss,
-                 _fmt(bool(r["brownout"])),
+                 _fmt(bool(r["brownout"])), _fmt(r["act"]),
                  ",".join(r["alerts"] or []) or "-", _fmt(r["age_s"], 0)]
         line = " ".join(f"{str(c):<{w}}" for c, (_, w) in zip(cells, cols))
         if color:

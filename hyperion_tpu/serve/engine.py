@@ -95,6 +95,8 @@ from hyperion_tpu.obs.tickprof import (
 from hyperion_tpu.serve.journal import MAX_REPLAYS_DEFAULT
 from hyperion_tpu.serve.metrics import ServeMetrics
 from hyperion_tpu.serve.queue import (
+    CLASS_BATCH,
+    CLASS_INTERACTIVE,
     REJECT_BAD_REQUEST,
     REJECT_DRAINING,
     REJECT_POISONED,
@@ -105,6 +107,11 @@ from hyperion_tpu.serve.queue import (
 )
 
 _SNAPSHOT_EVERY = 32  # ticks between metric snapshots on the stream
+
+# `_admit`'s third outcome: the slot was claimed but prefill proceeds
+# in chunks across later steps (distinct from None = allocation race,
+# which requeues). No token exists yet; the caller just moves on.
+_CHUNK_ADMIT = object()
 
 
 # --- the three compiled surfaces, shared process-wide -----------------
@@ -255,6 +262,24 @@ def _prefill_impl(model, eos_id, variables, cache, st, prompt, bt_row,
     return cache, st, first, finished
 
 
+def _chunk_impl(model, variables, cache, window, bt_row, start):
+    # one chunked-prefill segment (Sarathi-Serve, OSDI '24): write the
+    # K/V of `window`'s positions start..start+C-1 through this slot's
+    # block-table row and DISCARD the logits — no sampling happens
+    # until the final segment runs through `_prefill_impl`, whose fold
+    # position (total prompt length - 1) is independent of how the
+    # prefix was produced, so chunking never shifts the sampling
+    # stream. K/V at position p depend only on tokens 0..p, which every
+    # earlier segment already wrote: the values are bit-identical to a
+    # one-shot prefill of the same prompt. The window is a FIXED [1, C]
+    # shape — one executable per chunk size, forever.
+    _, cache = model.apply(
+        variables, window, cache=cache, cache_index=start,
+        block_tables=bt_row[None],
+    )
+    return cache
+
+
 def _copy_impl(cache, src, dst):
     # whole-block K/V copy (copy-on-write fork): dst becomes a private
     # duplicate the writer may overwrite from its divergence offset
@@ -270,12 +295,14 @@ _SHARED_JITS: dict[bool, tuple] = {}
 
 
 def _shared_jits(donate: bool) -> tuple:
-    """(tick, prefill, copy, spec_tick) jit objects, one set per
+    """(tick, prefill, copy, spec_tick, chunk) jit objects, one set per
     donation mode. Donation keeps the pool + state slabs in place on
     real chips; the CPU backend ignores donation with a warning, so
     callers pass donate=False there. The spec tick specializes on the
     drafts array's [S, k] shape, so one executable serves a given
-    (slots, k) forever — k is a config constant, never a retrace."""
+    (slots, k) forever — k is a config constant, never a retrace; the
+    chunk jit likewise specializes on the [1, C] window, one executable
+    per chunk size."""
     if donate not in _SHARED_JITS:
         _SHARED_JITS[donate] = (
             jax.jit(_tick_impl, static_argnums=(0, 1, 2),
@@ -286,6 +313,8 @@ def _shared_jits(donate: bool) -> tuple:
                     donate_argnums=(0,) if donate else ()),
             jax.jit(_spec_tick_impl, static_argnums=(0, 1, 2),
                     donate_argnums=(4, 5) if donate else ()),
+            jax.jit(_chunk_impl, static_argnums=(0,),
+                    donate_argnums=(2,) if donate else ()),
         )
     return _SHARED_JITS[donate]
 
@@ -319,6 +348,18 @@ class EngineConfig:
     # accept rule only keeps tokens the target would have produced)
     spec_k: int = 0                # draft tokens per slot per tick (0 = off)
     draft: str = "off"             # "ngram" (self-drafting) | "off"
+    # ---- SLO classes + chunked prefill (workload isolation) ----
+    # prompts whose uncached suffix exceeds `prefill_chunk` prefill in
+    # fixed [1, chunk] segments interleaved with decode ticks (one
+    # segment per step) — co-running slots' TTFT stops spiking on
+    # long-prompt admission, at one extra executable total
+    prefill_chunk: int = 0         # 0 = one-shot prefill (off)
+    interactive_weight: int = 3    # weighted-fair picks per pattern round
+    batch_weight: int = 1
+    batch_capacity: int = 0        # batch queue depth cap (0 = shared cap)
+    batch_deadline_s: float = 0.0  # default batch deadline (0 = none) —
+    #                                what makes batch sheddable under
+    #                                brownout when clients state no SLO
     # ---- overload brownout (serve/queue.py:BrownoutGovernor) ----
     brownout: bool = False         # enable the governor
     brownout_depth: int = 0        # enter watermark (0 = 3/4 of capacity)
@@ -405,11 +446,35 @@ class Engine:
                 f"num_blocks {num_blocks} cannot hold one worst-case "
                 f"request ({self._mb} blocks + the null block); raise "
                 f"--num-blocks or --block-size")
+        if cfg.prefill_chunk < 0 or cfg.prefill_chunk > L:
+            raise ValueError(
+                f"prefill_chunk must be in [0, max_len={L}], "
+                f"got {cfg.prefill_chunk}")
         self.cfg = dataclasses.replace(cfg, max_len=L, num_blocks=num_blocks)
         self.queue = AdmissionQueue(
             cfg.queue_capacity, max_total_tokens=L,
             prefill_budget=cfg.prefill_budget,
+            class_weights={CLASS_INTERACTIVE: cfg.interactive_weight,
+                           CLASS_BATCH: cfg.batch_weight},
+            class_capacity={CLASS_BATCH: cfg.batch_capacity}
+            if cfg.batch_capacity else None,
+            class_deadline_s={CLASS_BATCH: cfg.batch_deadline_s}
+            if cfg.batch_deadline_s else None,
         )
+        # router-ordered batch-class brownout (the `class_brownout`
+        # control verb): batch sheds/clamps as under the local governor,
+        # but interactive is NEVER touched — the order says "this
+        # replica is someone's overflow valve", not "this replica is
+        # drowning". Written by the exporter thread, read by the engine
+        # thread; a bool flip is atomic under the GIL.
+        self._class_brownout = False
+        # chunked-prefill slots: slot -> {req, prompt, budget, pos,
+        # row, resumed}. While a slot chunks, its real block-table row
+        # is held HERE and the device row stays zeroed: the decode tick
+        # writes K/V at lengths[slot] for every lane regardless of the
+        # live mask, and stale state in a reused slot must null-route,
+        # not corrupt the prompt's blocks mid-prefill.
+        self._chunking: dict[int, dict] = {}
         self.metrics = metrics or ServeMetrics()
         self.tracer = tracer if tracer is not None else trace_mod.null_tracer()
         self.hb = heartbeat if heartbeat is not None \
@@ -472,7 +537,7 @@ class Engine:
             getattr(x, "nbytes", 0)
             for x in jax.tree_util.tree_leaves(variables)))
         (self._tick_jit, self._prefill_jit, self._copy_jit,
-         self._spec_jit) = _shared_jits(
+         self._spec_jit, self._chunk_jit) = _shared_jits(
             donate=jax.default_backend() != "cpu")
 
     # ------------------------------------------------------ device state
@@ -515,6 +580,7 @@ class Engine:
             "prefill_executables": self._prefill_jit._cache_size(),
             "copy_executables": self._copy_jit._cache_size(),
             "spec_tick_executables": self._spec_jit._cache_size(),
+            "chunk_executables": self._chunk_jit._cache_size(),
         }
 
     def warmup(self, prompt_lens: list[int] | None = None) -> dict:
@@ -533,6 +599,13 @@ class Engine:
         want = self.bucket(max(prompt_lens or [self.cfg.min_bucket]))
         if self.cfg.admission == "optimistic":
             want = self.cfg.max_len
+        if self.cfg.prefill_chunk > 0:
+            # chunking caps every sampling prefill at the final segment
+            # (suffix <= chunk), so the ladder stops at bucket(chunk)
+            # no matter how long prompts get — resume growth under
+            # optimistic admission included (a grown prompt just chunks
+            # more segments)
+            want = self.bucket(self.cfg.prefill_chunk)
         lens: list[int] = []
         b = self.cfg.min_bucket
         while True:
@@ -564,6 +637,17 @@ class Engine:
                 _ = self._spec_tick_device(
                     np.zeros((self.cfg.slots, self.cfg.spec_k), np.int32))
                 compile_s["spec_tick"] = round(time.perf_counter() - t0, 4)
+            if self.cfg.prefill_chunk > 0:
+                # the chunk jit's ONE executable for this [1, C] window
+                # — all-null bt row, so the dummy's K/V land in the
+                # garbage block
+                C = self.cfg.prefill_chunk
+                t0 = time.perf_counter()
+                self._cache = self._chunk_jit(
+                    self.model, self.variables, self._cache,
+                    jnp.full((1, C), self.cfg.pad_id, jnp.int32),
+                    jnp.zeros((self._mb,), jnp.int32), jnp.int32(0))
+                compile_s["chunk"] = round(time.perf_counter() - t0, 4)
             zero = jnp.zeros((1,), jnp.int32)
             t0 = time.perf_counter()
             self._cache = self._copy_jit(self._cache, zero, zero)
@@ -573,6 +657,7 @@ class Engine:
         self._state = self._init_state()
         self._slots = [None] * self.cfg.slots
         self._seqs = [None] * self.cfg.slots
+        self._chunking = {}
         self._bt[:] = 0
         self._bt_dev = None
         stats = self.compile_stats()
@@ -623,15 +708,25 @@ class Engine:
         )
         return int(first), bool(finished)
 
+    def _live_mask(self) -> np.ndarray:
+        """Slots the decode tick may advance: occupied AND not mid-
+        chunk. A chunking slot's device row is zeroed and its state
+        rows are a previous occupant's leftovers — the mask (plus the
+        zeroed row, belt and braces) keeps the tick from decoding
+        garbage into it."""
+        return np.fromiter(
+            (r is not None and s not in self._chunking
+             for s, r in enumerate(self._slots)),
+            bool, len(self._slots))
+
     def _tick_device(self):
         if self._bt_dev is None:
             # upload only when the table or slot liveness changed —
             # steady-state decode re-uses the device copies, so a tick
             # costs zero host->device traffic
             t0u = time.monotonic()
-            live = np.fromiter((r is not None for r in self._slots),
-                               bool, len(self._slots))
-            self._bt_dev = (jnp.asarray(self._bt), jnp.asarray(live))
+            self._bt_dev = (jnp.asarray(self._bt),
+                            jnp.asarray(self._live_mask()))
             self._bt_upload_s += time.monotonic() - t0u
         self._cache, self._state, toks, fins = self._tick_jit(
             self.model, self.cfg.eos_id, self.cfg.pad_id,
@@ -647,7 +742,7 @@ class Engine:
         k = self.cfg.spec_k
         drafts = np.zeros((self.cfg.slots, k), np.int32)
         for s, req in enumerate(self._slots):
-            if req is not None:
+            if req is not None and s not in self._chunking:
                 drafts[s] = self._drafter.propose(
                     s, req.prompt_ids, req.tokens, k)
         return drafts
@@ -655,9 +750,8 @@ class Engine:
     def _spec_tick_device(self, drafts: np.ndarray):
         if self._bt_dev is None:
             t0u = time.monotonic()
-            live = np.fromiter((r is not None for r in self._slots),
-                               bool, len(self._slots))
-            self._bt_dev = (jnp.asarray(self._bt), jnp.asarray(live))
+            self._bt_dev = (jnp.asarray(self._bt),
+                            jnp.asarray(self._live_mask()))
             self._bt_upload_s += time.monotonic() - t0u
         self._cache, self._state, out, cnt, acc, fins = self._spec_jit(
             self.model, self.cfg.eos_id, self.cfg.pad_id,
@@ -727,6 +821,7 @@ class Engine:
             self.mgr.decref(seq.blocks)
         self._seqs[slot] = None
         self._slots[slot] = None
+        self._chunking.pop(slot, None)
         self._bt[slot, :] = 0
         self._bt_dev = None
 
@@ -783,10 +878,38 @@ class Engine:
             self.metrics.on_cow()
         if self.prefix is not None:
             self.metrics.on_prefix_lookup(P, start)
+        resumed = req.first_token_at is not None
+        C = self.cfg.prefill_chunk
+        if C > 0 and P - start > C:
+            # chunked prefill: the suffix is too long for one segment.
+            # Claim the slot and its blocks NOW (the gate already
+            # reserved them), but hold the real block-table row ASIDE
+            # and keep the device row zeroed — the decode tick writes
+            # K/V at lengths[slot] for ALL lanes and this slot's device
+            # state still belongs to a previous occupant, so its writes
+            # must null-route until the final segment installs real
+            # state. `_advance_chunks` runs one [1, C] segment per step
+            # between decode ticks; the prefix is NOT registered in the
+            # radix until the blocks actually hold it.
+            row = np.zeros((self._mb,), np.int32)
+            row[:len(seq.blocks)] = seq.blocks
+            self._bt[slot, :] = 0
+            self._bt_dev = None
+            seq.n_filled = start
+            self._slots[slot] = req
+            self._seqs[slot] = seq
+            self._chunking[slot] = {
+                "req": req, "prompt": prompt, "budget": budget,
+                "pos": start, "row": row, "resumed": resumed,
+            }
+            self.tracer.event(
+                "prefill_chunked", request=req.id, tick=self._tick_no,
+                slot=slot, prompt_len=P, cached_tokens=start, chunk=C,
+                segments=-(-(P - start) // C), resumed=resumed)
+            return _CHUNK_ADMIT
         self._bt[slot, :len(seq.blocks)] = seq.blocks
         self._bt[slot, len(seq.blocks):] = 0
         self._bt_dev = None
-        resumed = req.first_token_at is not None
         with self.tracer.span("serve_prefill", step=self._tick_no) as sp:
             first, finished = self._prefill_call(
                 req, slot, start=start, prompt=prompt, budget=budget)
@@ -815,7 +938,7 @@ class Engine:
         else:
             gap_from = getattr(req, "_last_emit_at", None)
             if gap_from is not None:
-                self.metrics.on_token_gap(now - gap_from)
+                self.metrics.on_token_gap(now - gap_from, req.sla_class)
         req._last_emit_at = now
         req._sink_mark = self._sink_s
         self.metrics.count_tokens(1)  # the prefill-sampled token
@@ -825,18 +948,100 @@ class Engine:
             self._free_slot(slot)
         return TokenEvent(req, first, finished)
 
-    def _preempt(self, slot: int) -> None:
-        """Pool exhausted: push this slot's request back to the queue
-        HEAD (recompute preemption — generated tokens ride along and
-        re-prefill on re-admission, often from their own radix-cached
-        prefix). The degraded-but-alive alternative to a crash."""
+    def _advance_chunks(self) -> list[TokenEvent]:
+        """Run at most ONE prefill segment this step — the oldest
+        chunking slot's — so long prompts interleave with decode ticks
+        instead of stalling them (Sarathi-Serve's stall-free schedule).
+        Intermediate segments go through the chunk jit (K/V only, no
+        sampling); the final segment (suffix <= chunk, so its bucket is
+        already on the warmup ladder) runs the normal sampling prefill
+        with `start` at the chunk boundary — the fold position is the
+        total prompt length - 1 either way, so the first token is
+        bit-identical to a one-shot prefill."""
+        if not self._chunking:
+            return []
+        C = self.cfg.prefill_chunk
+        slot = min(self._chunking, key=lambda s: self._seqs[s].order)
+        ck = self._chunking[slot]
+        req, prompt, budget = ck["req"], ck["prompt"], ck["budget"]
+        P = int(prompt.shape[0])
+        pos = ck["pos"]
+        if P - pos > C:
+            t0 = time.monotonic()
+            self._cache = self._chunk_jit(
+                self.model, self.variables, self._cache,
+                jnp.asarray(np.asarray(prompt[pos:pos + C],
+                                       np.int32)[None, :]),
+                jnp.asarray(ck["row"]), jnp.int32(pos))
+            # fence: the segment's wall time must land in THIS step's
+            # chunk segment, not smear into the next device call
+            jax.block_until_ready(self._cache)
+            dt = time.monotonic() - t0
+            if ck["resumed"]:
+                req.replay_s += dt
+            else:
+                req.prefill_s += dt
+            ck["pos"] = pos + C
+            self._seqs[slot].n_filled = pos + C
+            return []
+        # final segment: install the real row — `_prefill_impl` sets
+        # every state field for this slot via `.at[slot].set`, so the
+        # stale-lane hazard ends here
+        self._bt[slot, :] = ck["row"]
+        self._bt_dev = None
+        del self._chunking[slot]
+        resumed = ck["resumed"]
+        with self.tracer.span("serve_prefill", step=self._tick_no) as sp:
+            first, finished = self._prefill_call(
+                req, slot, start=pos, prompt=prompt, budget=budget)
+            sp.set(request=req.id, slot=slot, prompt_len=P,
+                   cached_tokens=pos, bucket=self.bucket(P - pos),
+                   resumed=resumed, chunked=True)
+        seq = self._seqs[slot]
+        seq.n_filled = P
+        if self.prefix is not None:
+            self.prefix.insert(prompt, seq.blocks)
+        now = time.monotonic()
+        req.prefilled_at = now
+        if resumed:
+            req.replay_s += sp.dur_s or 0.0
+        else:
+            req.prefill_s += sp.dur_s or 0.0
+        if not resumed:
+            req.first_token_at = now
+            self.metrics.on_first_token(req, now)
+            self.tracer.event(
+                "request_first_token", request=req.id, tick=self._tick_no,
+                ttft_s=round(now - req.submitted_at, 6),
+                queue_wait_s=round(req.queue_wait_s, 6),
+                gate_wait_s=round(req.gate_wait_s, 6),
+                prefill_s=round(req.prefill_s, 6), chunked=True)
+        else:
+            gap_from = getattr(req, "_last_emit_at", None)
+            if gap_from is not None:
+                self.metrics.on_token_gap(now - gap_from, req.sla_class)
+        req._last_emit_at = now
+        req._sink_mark = self._sink_s
+        self.metrics.count_tokens(1)  # the prefill-sampled token
+        if finished:
+            self._free_slot(slot)
+        return [TokenEvent(req, first, finished)]
+
+    def _preempt(self, slot: int, reason: str = "pool_exhausted") -> None:
+        """Push this slot's request back to the queue HEAD (recompute
+        preemption — generated tokens ride along and re-prefill on
+        re-admission, often from their own radix-cached prefix). Fires
+        on pool exhaustion and on preempt-batch-for-interactive (a
+        block-gated interactive head evicting the youngest batch slot).
+        The degraded-but-alive alternative to a crash."""
         req = self._slots[slot]
         self._free_slot(slot)
         self.metrics.on_preempt()
         req.preempts += 1
         req._preempted = True  # its next queue wait is replay, not FIFO
         self.tracer.event("request_preempted", request=req.id,
-                          generated=len(req.tokens), tick=self._tick_no)
+                          generated=len(req.tokens), tick=self._tick_no,
+                          reason=reason, sla_class=req.sla_class)
         self.queue.push_front(req)
 
     def _account_pop(self, req) -> bool:
@@ -864,8 +1069,9 @@ class Engine:
             req.queue_wait_s += wait - gate
         if self._governor is not None:
             # every completed wait (replay stints included — congestion
-            # is congestion) feeds the brownout p95 window
-            self._governor.observe_wait(wait)
+            # is congestion) feeds the brownout p95 window, tagged with
+            # its class so shed_doomed can estimate per-class
+            self._governor.observe_wait(wait, req.sla_class)
         self.tracer.event(
             "request_scheduled", request=req.id, tick=self._tick_no,
             resumed=resumed,
@@ -909,11 +1115,16 @@ class Engine:
                     seq.blocks.append(got[0])
                     self._bt_dev = None
                     continue
-                victim = max(
-                    (t for t in range(self.cfg.slots)
-                     if self._slots[t] is not None),
-                    key=lambda t: self._seqs[t].order,
-                )
+                live = [t for t in range(self.cfg.slots)
+                        if self._slots[t] is not None]
+                # batch absorbs pool pressure first: evict the
+                # youngest batch slot when one exists, the youngest
+                # overall otherwise (the starvation-freedom argument —
+                # oldest always progresses — is unchanged either way)
+                batch = [t for t in live
+                         if self._slots[t].sla_class == CLASS_BATCH]
+                victim = max(batch or live,
+                             key=lambda t: self._seqs[t].order)
                 self._preempt(victim)
 
     # ------------------------------------------------------------ events
@@ -960,7 +1171,9 @@ class Engine:
                 self._journal_s += time.monotonic() - jt0
             self._journal_guard()
         if self.chaos is not None:
-            self.chaos.on_client(self._tick_no)
+            # the request rides along so tenant-targeted client chaos
+            # (slowloris@tenant=...) can pick its victim
+            self.chaos.on_client(self._tick_no, req)
         if req.sink is not None:
             t0 = time.monotonic()
             try:
@@ -1031,7 +1244,16 @@ class Engine:
         """Queue a request (thread-safe). Rejections emit immediately —
         backpressure the caller can act on, not a silent drop."""
         gov = self._governor
-        if gov is not None and gov.active and self.cfg.brownout_clamp > 0 \
+        gov_active = gov is not None and gov.active
+        # shed order made admission policy: batch clamps whenever ANY
+        # brownout holds (local governor or router-ordered); interactive
+        # clamps only when the local governor is active AND the batch
+        # queue is already empty — batch absorbs every degradation
+        # first, and a router order alone never touches interactive
+        clamp_this = (gov_active or self._class_brownout) \
+            if req.sla_class == CLASS_BATCH \
+            else (gov_active and self.queue.depth_of(CLASS_BATCH) == 0)
+        if clamp_this and self.cfg.brownout_clamp > 0 \
                 and req.max_new_tokens > self.cfg.brownout_clamp:
             # brownout clamp, applied BEFORE the journal sees the
             # request: the WAL must record the budget actually served,
@@ -1050,13 +1272,16 @@ class Engine:
             self._journal_guard()
         ok, reason = self.queue.submit(req)
         if ok:
-            self.metrics.on_accept()
+            self.metrics.on_accept(req.sla_class)
             if req.clamped_from is not None:
-                self.metrics.on_clamp()
+                self.metrics.on_clamp(req.sla_class)
             self.tracer.event("request_admitted", request=req.id,
                               prompt_len=req.prompt_len,
                               max_new_tokens=req.max_new_tokens,
                               deadline_s=req.deadline_s,
+                              sla_class=req.sla_class,
+                              **({"tenant": req.tenant}
+                                 if req.tenant else {}),
                               **({"clamped_from": req.clamped_from}
                                  if req.clamped_from is not None else {}))
         else:
@@ -1067,6 +1292,9 @@ class Engine:
             self.metrics.on_reject(reason)
             self.tracer.event("request_rejected", request=req.id,
                               reason=reason, prompt_len=req.prompt_len,
+                              sla_class=req.sla_class,
+                              **({"tenant": req.tenant}
+                                 if req.tenant else {}),
                               queued_s=0.0)
             self._emit(TokenEvent(req, None, True, kind="rejected",
                                   reason=reason))
@@ -1224,8 +1452,16 @@ class Engine:
             "occupancy": round(self.n_active / self.cfg.slots, 4)
             if self.cfg.slots else 0.0,
             "queue": len(self.queue),
+            "queue_by_class": self.queue.depth_by_class(),
             "draining": self._draining,
             "brownout": bool(gov.active) if gov is not None else False,
+            # the act dict: what degradation/scheduling posture this
+            # engine is in RIGHT NOW (obs top's `act` column)
+            "act": {
+                "class_brownout": self._class_brownout,
+                "brownout": bool(gov.active) if gov is not None else False,
+                "chunking": len(self._chunking),
+            },
             "blocks_in_use": self.mgr.in_use,
             "blocks_free": self.mgr.num_free,
             "alerts": (self.slo.active_names()
@@ -1290,6 +1526,22 @@ class Engine:
                                   float(req.get("seconds") or 5.0))
             self.tracer.event("profile_requested", **res)
             return res
+        if cmd == "class_brownout":
+            # the router's degradation order (obs/export.py control
+            # protocol): shed/clamp the batch class as if the local
+            # governor were active, but never touch interactive — the
+            # order means "yield batch capacity to the fleet", not
+            # "this replica is drowning". Idempotent; a bool flip is
+            # atomic under the GIL, so no lock against the engine
+            # thread is needed.
+            active = bool(req.get("active", True))
+            changed = active != self._class_brownout
+            self._class_brownout = active
+            if changed:
+                self.metrics.set_class_brownout(active)
+                self.tracer.event("class_brownout", tick=self._tick_no,
+                                  active=active, source="control")
+            return {"status": "ok", "active": active, "changed": changed}
         return {"status": "error", "error": f"unknown cmd {cmd!r}"}
 
     def step(self) -> list[TokenEvent]:
@@ -1321,29 +1573,42 @@ class Engine:
                 self.metrics.set_brownout(False)
                 self.tracer.event("brownout_exit", tick=self._tick_no,
                                   depth=len(self.queue))
-            if self._governor.active:
-                # shed deadline-aware, cheapest first: queued requests
-                # that cannot meet their deadline even if service began
-                # after the current estimated wait are already doomed —
-                # reject them NOW so the client retries elsewhere
-                # instead of burning a queue slot toward a timeout
-                for req in self.queue.shed_doomed(
-                        now, self._governor.wait_p95()):
-                    self.metrics.on_shed()
-                    req.finish_reason = REJECT_SHED
-                    # the standard reject vocabulary (shed=true rides
-                    # along): `obs trace` keeps shed requests in the
-                    # same attribution tables as door rejects, with
-                    # the queue time they DID burn before dying
-                    self.tracer.event(
-                        "request_rejected", request=req.id,
-                        tick=self._tick_no, reason=REJECT_SHED, shed=True,
-                        queued_s=round(max(0.0, now - req.enqueued_at), 6),
-                        deadline_s=req.deadline_s)
-                    ev = TokenEvent(req, None, True, kind="rejected",
-                                    reason=REJECT_SHED)
-                    self._emit(ev)
-                    emissions.append(ev)
+        gov_active = self._governor is not None and self._governor.active
+        if gov_active or self._class_brownout:
+            # shed deadline-aware, cheapest first, BATCH FIRST: queued
+            # requests that cannot meet their deadline even if service
+            # began after their CLASS's estimated wait are already
+            # doomed — reject them NOW so the client retries elsewhere
+            # instead of burning a queue slot toward a timeout.
+            # Interactive is swept only when the local governor is
+            # active AND batch is already empty (a router-ordered
+            # class brownout alone never touches interactive).
+            shed_classes = [CLASS_BATCH]
+            if gov_active and self.queue.depth_of(CLASS_BATCH) == 0:
+                shed_classes = [CLASS_INTERACTIVE]
+            est = {cls: self._governor.wait_p95(cls)
+                   for cls in shed_classes} \
+                if self._governor is not None else {}
+            for req in self.queue.shed_doomed(
+                    now, est_wait_by_class=est,
+                    classes=tuple(shed_classes)):
+                self.metrics.on_shed(req.sla_class)
+                req.finish_reason = REJECT_SHED
+                # the standard reject vocabulary (shed=true rides
+                # along): `obs trace` keeps shed requests in the
+                # same attribution tables as door rejects, with
+                # the queue time they DID burn before dying
+                self.tracer.event(
+                    "request_rejected", request=req.id,
+                    tick=self._tick_no, reason=REJECT_SHED, shed=True,
+                    sla_class=req.sla_class,
+                    **({"tenant": req.tenant} if req.tenant else {}),
+                    queued_s=round(max(0.0, now - req.enqueued_at), 6),
+                    deadline_s=req.deadline_s)
+                ev = TokenEvent(req, None, True, kind="rejected",
+                                reason=REJECT_SHED)
+                self._emit(ev)
+                emissions.append(ev)
 
         t_seg = time.monotonic()
         free = [s for s, r in enumerate(self._slots) if r is None]
@@ -1356,6 +1621,21 @@ class Engine:
             expired += self.queue.drop_expired(now)
         else:
             admit, expired = [], self.queue.drop_expired(now)
+        if CLASS_INTERACTIVE in self.queue.gate_blocked:
+            # an interactive head is denied by the block gate while
+            # batch work holds slots: preempt the YOUNGEST batch slot
+            # to the queue (recompute resume — nothing is lost) so the
+            # freed blocks admit the interactive head next round. One
+            # victim per step: pool accounting settles between rounds,
+            # and a single long prompt must not massacre the whole
+            # batch tier in one tick.
+            batch_live = [
+                s for s, r in enumerate(self._slots)
+                if r is not None and r.sla_class == CLASS_BATCH]
+            if batch_live:
+                victim = max(batch_live,
+                             key=lambda t: self._seqs[t].order)
+                self._preempt(victim, reason="interactive_gate")
         seg["queue_pop"] = time.monotonic() - t_seg
         t_seg = time.monotonic()
         j_mark, s_mark = self._journal_s, self._sink_s
@@ -1384,6 +1664,10 @@ class Engine:
                 self.chaos.on_request(req.id)
             resumed = self._account_pop(req)
             ev = self._admit(req, slot)
+            if ev is _CHUNK_ADMIT:
+                # the slot is claimed and prefilling in chunks across
+                # later steps; no token yet, nothing to emit
+                continue
             if ev is None:
                 # allocation raced an eviction between gate and admit:
                 # requeue head-first in arrival order and retry next
@@ -1412,9 +1696,24 @@ class Engine:
                            - (self._journal_s - j_mark)
                            - (self._sink_s - s_mark))
 
+        # one chunked-prefill segment per step, interleaved with the
+        # decode tick below — the whole point: co-running slots tick
+        # every step while a long prompt fills in bounded bites
+        t_seg = time.monotonic()
+        j_mark, s_mark = self._journal_s, self._sink_s
+        for ev in self._advance_chunks():
+            self._emit(ev)
+            emissions.append(ev)
+            if ev.finished:
+                self._on_finished(ev.request)
+        seg["chunk"] = max(0.0, (time.monotonic() - t_seg)
+                           - (self._journal_s - j_mark)
+                           - (self._sink_s - s_mark))
+
         if self.n_active:
             self._ensure_blocks()
-        if self.n_active:
+        n_live = self.n_active - len(self._chunking)
+        if n_live > 0:
             if self.chaos is not None:
                 self.chaos.on_tick(self._tick_no)
             spec = self._spec
@@ -1440,7 +1739,10 @@ class Engine:
             tnow = time.monotonic()
             j_mark, s_mark = self._journal_s, self._sink_s
             for s, req in enumerate(self._slots):
-                if req is None:
+                if req is None or s in self._chunking:
+                    # a chunking slot is masked out of the tick — its
+                    # lane computed pad into the null block, nothing
+                    # to route
                     continue
                 slot_ticks += 1
                 n = int(cnts[s]) if spec else 1
@@ -1459,7 +1761,8 @@ class Engine:
                     # the pass pro-rata across them — the per-token
                     # cadence a streaming client actually experiences
                     for _ in range(n):
-                        self.metrics.on_token_gap((tnow - gap_from) / n)
+                        self.metrics.on_token_gap((tnow - gap_from) / n,
+                                                  req.sla_class)
                     sink = self._sink_s - getattr(
                         req, "_sink_mark", self._sink_s)
                     req.decode_s += max(0.0, tnow - gap_from - sink)

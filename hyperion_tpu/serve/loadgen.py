@@ -25,7 +25,12 @@ import numpy as np
 from hyperion_tpu.obs.export import DEFAULT_WINDOW_S
 from hyperion_tpu.obs.registry import percentile
 from hyperion_tpu.obs.timeline import PHASES, cohort_dominant
-from hyperion_tpu.serve.queue import Request
+from hyperion_tpu.serve.queue import (
+    CLASS_BATCH,
+    CLASS_INTERACTIVE,
+    SLA_CLASSES,
+    Request,
+)
 
 # THE serving-row vocabulary: every key a `run_load` report carries
 # that `obs diff`'s normalize() may consume. `scripts/check_diff_gates.py`
@@ -43,6 +48,12 @@ SERVING_REPORT_KEYS = (
     *(f"{p}_p99_ms" for p in PHASES),
     "dominant_phase_p99", "ttft_p99_windowed_ms", "tpot_p99_windowed_ms",
     "alerts_raised", "alerts_active", "recompiles",
+    # per-SLO-class isolation keys (PR 14): the `@class` bench
+    # dimension's verdict row — interactive latency must hold while
+    # batch absorbs the sheds
+    *(f"{cls}_{k}" for cls in SLA_CLASSES
+      for k in ("ttft_p99_ms", "tpot_p99_ms", "completed", "shed",
+                "shed_rate")),
 )
 
 
@@ -63,6 +74,26 @@ class LoadSpec:
     # (the first request prefills the prefix, every later one reuses
     # its blocks). The bench `serving` probe runs this mode.
     shared_prefix_tokens: int = 0
+    # --- SLO-class mix (PR 14) ---
+    # > 0: every batch_every-th request is class=batch — the mixed
+    # workload the isolation drill and the bench `@class` dimension run
+    batch_every: int = 0
+    # --- adversarial tenant (PR 14) ---
+    # one deterministic hostile tenant rides the base workload:
+    #   burst     — its arrivals all collapse onto the first one (a
+    #               thundering herd from one client)
+    #   slowloris — its sinks sleep adversary_secs per token (a client
+    #               that reads one byte at a time; in-process runs slow
+    #               the sink, wire runs pair with the chaos clause)
+    #   oversize  — its prompts balloon to adversary_prompt_len and it
+    #               self-identifies as batch (the giant-prompt tenant
+    #               chunked prefill exists for)
+    # Shaping draws come from a SEPARATE rng AFTER the base draws, so
+    # enabling a tenant never shifts the pinned base schedule.
+    adversary: str = ""            # "" | burst | slowloris | oversize
+    adversary_every: int = 0       # every Nth request is the tenant's
+    adversary_secs: float = 0.05   # slowloris per-token stall
+    adversary_prompt_len: int = 0  # oversize length (0 = 4x max base)
 
 
 def request_id(seed: int, i: int) -> str:
@@ -90,14 +121,50 @@ def build_workload(spec: LoadSpec):
         tail = rng.integers(1, spec.vocab, rng.choice(spec.prompt_lens))
         return tail if prefix is None else np.concatenate([prefix, tail])
 
+    # base draws first, ALL of them, in the pinned order — class and
+    # adversary shaping below reads a separate rng, so the same seed
+    # yields the same base workload whatever tenants ride along
+    base = [(next_prompt(), int(rng.choice(spec.max_new)),
+             int(rng.integers(0, 2**31 - 1)))
+            for _ in range(spec.n_requests)]
+
+    cls_of: dict[int, str] = {}
+    tenant_of: dict[int, str] = {}
+    prompt_of: dict[int, np.ndarray] = {}
+    if spec.batch_every > 0:
+        for i in range(spec.n_requests):
+            if (i + 1) % spec.batch_every == 0:
+                cls_of[i] = CLASS_BATCH
+    if spec.adversary and spec.adversary_every > 0:
+        arng = np.random.default_rng(spec.seed + 0x5EED)
+        tenant = f"adv_{spec.adversary}"
+        adv = [i for i in range(spec.n_requests)
+               if (i + 1) % spec.adversary_every == 0]
+        for i in adv:
+            tenant_of[i] = tenant
+        if spec.adversary == "burst" and adv:
+            # thundering herd: every adversary arrival collapses onto
+            # the tenant's first — the instant-queue-spike shape the
+            # class-aware shed order must absorb batch-first
+            arrivals = arrivals.copy()
+            arrivals[adv] = arrivals[adv[0]]
+        elif spec.adversary == "oversize":
+            plen = spec.adversary_prompt_len \
+                or 4 * max(spec.prompt_lens)
+            for i in adv:
+                prompt_of[i] = arng.integers(1, spec.vocab, plen)
+                cls_of[i] = CLASS_BATCH
+
     reqs = [
         Request(
-            prompt_ids=next_prompt(),
-            max_new_tokens=int(rng.choice(spec.max_new)),
+            prompt_ids=prompt_of.get(i, base[i][0]),
+            max_new_tokens=base[i][1],
             temperature=spec.temperature,
-            seed=int(rng.integers(0, 2**31 - 1)),
+            seed=base[i][2],
             deadline_s=spec.deadline_s,
             id=request_id(spec.seed, i),
+            sla_class=cls_of.get(i, CLASS_INTERACTIVE),
+            tenant=tenant_of.get(i),
         )
         for i in range(spec.n_requests)
     ]
@@ -114,6 +181,18 @@ def run_load(engine, spec: LoadSpec) -> dict:
     closed-loop, so a slow engine sees a burstier queue, exactly like
     a real ingress under fixed offered load."""
     arrivals, reqs = build_workload(spec)
+    if spec.adversary == "slowloris" and spec.adversary_secs > 0:
+        # the adversarial client that reads one byte at a time: its own
+        # sink stalls on every token. The engine charges the stall to
+        # the REQUEST's client_write phase (decode gaps are netted of
+        # sink time), so the isolation claim — everyone else's TTFT and
+        # TPOT hold — is measurable, not hopeful.
+        def _slow_sink(rec, _secs=spec.adversary_secs):
+            time.sleep(_secs)
+
+        for r in reqs:
+            if r.tenant is not None:
+                r.sink = _slow_sink
     if spec.shared_prefix_tokens and hasattr(engine, "tracer"):
         # stamp the workload shape on the stream: `obs doctor` uses
         # this to call out a shared-prefix run whose hit counter
@@ -177,7 +256,31 @@ def run_load(engine, spec: LoadSpec) -> dict:
         [r.finished_at - r.submitted_at for r in done],
         [r.phases_s() for r in done])
 
+    # per-SLO-class verdict keys: client-observed TTFT per class (from
+    # the requests' own stamps), TPOT p99 from the engine's per-class
+    # histograms, and the shed split — the isolation drill's whole
+    # claim is interactive_ttft holds while batch_shed absorbs the hit
+    by_cls = cache.get("by_class") or {}
+    per_class: dict = {}
+    for cls in SLA_CLASSES:
+        cdone = [r for r in done if r.sla_class == cls]
+        cttft = [(r.first_token_at - r.submitted_at) * 1e3
+                 for r in cdone if r.first_token_at is not None]
+        tpot = (by_cls.get(cls) or {}).get("tpot_ms") or {}
+        shed = int((by_cls.get(cls) or {}).get("shed", 0))
+        n_cls = sum(1 for r in reqs if r.sla_class == cls)
+        per_class[f"{cls}_ttft_p99_ms"] = (
+            round(percentile(cttft, 99), 3) if cttft else None)
+        per_class[f"{cls}_tpot_p99_ms"] = (
+            round(tpot["p99"], 3)
+            if isinstance(tpot.get("p99"), (int, float)) else None)
+        per_class[f"{cls}_completed"] = len(cdone)
+        per_class[f"{cls}_shed"] = shed
+        per_class[f"{cls}_shed_rate"] = (
+            round(shed / n_cls, 4) if n_cls else 0.0)
+
     return {
+        **per_class,
         "requests": spec.n_requests,
         "completed": len(done),
         "rejected": rejected,
@@ -281,8 +384,18 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
         }
         if req.deadline_s is not None:
             doc["deadline_s"] = float(req.deadline_s)
+        if req.sla_class != CLASS_INTERACTIVE:
+            doc["class"] = req.sla_class
+        if req.tenant is not None:
+            doc["tenant"] = req.tenant
         if session_every > 0:
             doc["session_id"] = f"sess_{i // session_every}"
+        # the wire-path slowloris: the tenant's own reader stalls
+        # between records, starving its socket buffer exactly like a
+        # real one-byte-at-a-time client
+        stall = (spec.adversary_secs
+                 if spec.adversary == "slowloris" and req.tenant
+                 else 0.0)
         res = results[i]
         sent = time.monotonic()
         res["submitted_at"] = sent
@@ -291,6 +404,8 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
                              timeout_s=request_timeout_s) as c:
                 for rec in c.stream(**doc):
                     ev = rec.get("event")
+                    if stall > 0:
+                        time.sleep(stall)
                     if ev == "token" and rec.get("token") is not None:
                         res.setdefault("first_token_at", time.monotonic())
                         res["tokens"] = res.get("tokens", 0) + 1

@@ -24,6 +24,7 @@ from __future__ import annotations
 import time
 
 from hyperion_tpu.obs.registry import MetricsRegistry
+from hyperion_tpu.serve.queue import SLA_CLASSES
 
 
 class ServeMetrics:
@@ -62,6 +63,13 @@ class ServeMetrics:
                      # recompile-free invariant
                      "serve_recompiles"):
             self.reg.counter(name)
+        # per-SLO-class lifecycle counters: the isolation contract is
+        # judged from these (batch sheds while interactive sheds stay
+        # 0), so every class/key pair must render even when untouched
+        for cls in SLA_CLASSES:
+            for stem in ("serve_accepted", "serve_completed",
+                         "serve_shed", "serve_brownout_clamped"):
+                self.reg.counter(f"{stem}_{cls}")
         self._spec_drafted = 0
         self._spec_accepted = 0
         self._tick_tokens = 0
@@ -69,11 +77,16 @@ class ServeMetrics:
         # 0/1 flag, pre-set so "never browned out" snapshots as 0
         self.reg.gauge("serve_brownout_active").set(0.0)
         self.reg.gauge("serve_alerts_active").set(0.0)
+        # router-ordered batch brownout (the `class_brownout` control
+        # verb), distinct from the local governor's flag
+        self.reg.gauge("serve_class_brownout").set(0.0)
 
     # -------------------------------------------------- admission edge
 
-    def on_accept(self) -> None:
+    def on_accept(self, sla_class: str | None = None) -> None:
         self.reg.counter("serve_accepted").inc()
+        if sla_class:
+            self.reg.counter(f"serve_accepted_{sla_class}").inc()
 
     def on_reject(self, reason: str) -> None:
         self.reg.counter("serve_rejected").inc()
@@ -91,15 +104,22 @@ class ServeMetrics:
 
     def on_first_token(self, req, now: float | None = None) -> None:
         now = self._clock() if now is None else now
-        self.reg.histogram("ttft_ms").observe(
-            (now - req.submitted_at) * 1e3)
+        ttft_ms = (now - req.submitted_at) * 1e3
+        self.reg.histogram("ttft_ms").observe(ttft_ms)
+        # per-class TTFT is the isolation number: interactive's tail
+        # must hold while batch absorbs the hostile load
+        self.reg.histogram(f"ttft_{req.sla_class}_ms").observe(ttft_ms)
 
-    def on_token_gap(self, gap_s: float) -> None:
+    def on_token_gap(self, gap_s: float, sla_class: str | None = None,
+                     ) -> None:
         self.reg.histogram("tpot_ms").observe(gap_s * 1e3)
+        if sla_class:
+            self.reg.histogram(f"tpot_{sla_class}_ms").observe(gap_s * 1e3)
 
     def on_finish(self, req, now: float | None = None) -> None:
         now = self._clock() if now is None else now
         self.reg.counter("serve_completed").inc()
+        self.reg.counter(f"serve_completed_{req.sla_class}").inc()
         self.reg.histogram("e2e_ms").observe(
             (now - req.submitted_at) * 1e3)
 
@@ -141,16 +161,26 @@ class ServeMetrics:
 
     # ------------------------------------- crash safety + overload (PR 8)
 
-    def on_shed(self) -> None:
+    def on_shed(self, sla_class: str | None = None) -> None:
         """Brownout shed one deadline-doomed queued request."""
         self.reg.counter("serve_shed").inc()
+        if sla_class:
+            self.reg.counter(f"serve_shed_{sla_class}").inc()
 
-    def on_clamp(self) -> None:
+    def on_clamp(self, sla_class: str | None = None) -> None:
         """Brownout clamped a new admission's max_new_tokens."""
         self.reg.counter("serve_brownout_clamped").inc()
+        if sla_class:
+            self.reg.counter(f"serve_brownout_clamped_{sla_class}").inc()
 
     def set_brownout(self, active: bool) -> None:
         self.reg.gauge("serve_brownout_active").set(1.0 if active else 0.0)
+
+    def set_class_brownout(self, active: bool) -> None:
+        """Router-ordered batch-class brownout (the PR-13 control-verb
+        channel) — tracked apart from the local governor so the
+        exposition payload can say WHO degraded the batch tier."""
+        self.reg.gauge("serve_class_brownout").set(1.0 if active else 0.0)
 
     def on_replay(self) -> None:
         """One journaled request re-admitted at recovery."""
@@ -287,6 +317,18 @@ class ServeMetrics:
             "shed": int(c.get("serve_shed", 0)),
             "brownout_clamped": int(c.get("serve_brownout_clamped", 0)),
             "brownout_active": bool(g.get("serve_brownout_active", 0.0)),
+            "class_brownout": bool(g.get("serve_class_brownout", 0.0)),
+            # per-SLO-class isolation roll-up: the drill's verdict keys
+            "by_class": {
+                cls: {
+                    "accepted": int(c.get(f"serve_accepted_{cls}", 0)),
+                    "completed": int(c.get(f"serve_completed_{cls}", 0)),
+                    "shed": int(c.get(f"serve_shed_{cls}", 0)),
+                    "clamped": int(
+                        c.get(f"serve_brownout_clamped_{cls}", 0)),
+                    "ttft_ms": h.get(f"ttft_{cls}_ms", {"count": 0}),
+                    "tpot_ms": h.get(f"tpot_{cls}_ms", {"count": 0}),
+                } for cls in SLA_CLASSES},
             "replayed": int(c.get("serve_replayed", 0)),
             "poisoned": int(c.get("serve_poisoned", 0)),
             "journal_errors": int(c.get("serve_journal_errors", 0)),
@@ -332,12 +374,20 @@ class RouterMetrics:
                      # the fleet tally of alerts its replicas report on
                      # their heartbeats — both pre-created so 0 renders
                      "route_alerts_raised", "route_alerts_cleared",
-                     "fleet_alerts_raised"):
+                     "fleet_alerts_raised",
+                     # the acting router (alert-driven control): every
+                     # steer/scale/brownout decision is counted so a
+                     # flapping policy is visible as a number, not vibes
+                     "router_steers", "router_unsteers",
+                     "router_scale_up", "router_scale_down",
+                     "class_brownouts_ordered",
+                     "class_brownouts_lifted"):
             self.reg.counter(name)
         self.reg.gauge("fleet_ready").set(0.0)
         self.reg.gauge("fleet_inflight").set(0.0)
         self.reg.gauge("fleet_alerts_active").set(0.0)
         self.reg.gauge("route_alerts_active").set(0.0)
+        self.reg.gauge("fleet_steered").set(0.0)
 
     def on_dispatch(self, replica: int, affinity_hit: bool,
                     had_key: bool) -> None:
@@ -383,6 +433,27 @@ class RouterMetrics:
             if alerts_active is not None:
                 self.reg.gauge("fleet_alerts_active").set(alerts_active)
 
+    def on_steer(self, on: bool) -> None:
+        """One steering transition: `on` = interactive traffic moved
+        OFF a burning replica, False = hysteresis-clean reversal."""
+        with self._lock:
+            self.reg.counter(
+                "router_steers" if on else "router_unsteers").inc()
+
+    def on_scale(self, up: bool) -> None:
+        with self._lock:
+            self.reg.counter(
+                "router_scale_up" if up else "router_scale_down").inc()
+
+    def on_class_brownout(self, on: bool) -> None:
+        with self._lock:
+            self.reg.counter("class_brownouts_ordered" if on
+                             else "class_brownouts_lifted").inc()
+
+    def observe_steered(self, n: int) -> None:
+        with self._lock:
+            self.reg.gauge("fleet_steered").set(n)
+
     def on_fleet_alerts(self, n_new: int) -> None:
         """`n_new` alert names appeared on replica heartbeats since the
         last monitor sweep (serve/router.py counts the transitions —
@@ -417,4 +488,11 @@ class RouterMetrics:
             "alerts_raised": int(c.get("route_alerts_raised", 0)),
             "fleet_alerts_raised": int(c.get("fleet_alerts_raised", 0)),
             "fleet_alerts_active": int(g.get("fleet_alerts_active") or 0),
+            # the acting router: control decisions taken this run
+            "steers": int(c.get("router_steers", 0)),
+            "unsteers": int(c.get("router_unsteers", 0)),
+            "scale_up": int(c.get("router_scale_up", 0)),
+            "scale_down": int(c.get("router_scale_down", 0)),
+            "class_brownouts": int(c.get("class_brownouts_ordered", 0)),
+            "steered_now": int(g.get("fleet_steered") or 0),
         }

@@ -41,6 +41,16 @@ import numpy as np
 
 _ids = itertools.count()
 
+# SLO classes (the `class` wire field): `interactive` is the latency
+# tier — TTFT is the product; `batch` is the throughput tier — it
+# absorbs every degradation first (sheds, clamps, preemption) so that
+# one hostile batch tenant can never tax an interactive request's tail.
+# Unknown class strings normalize to interactive: misspelling a class
+# must never silently demote a request to the sheddable tier.
+CLASS_INTERACTIVE = "interactive"
+CLASS_BATCH = "batch"
+SLA_CLASSES = (CLASS_INTERACTIVE, CLASS_BATCH)
+
 # machine-readable rejection reasons (the wire contract; tests and the
 # metrics counters key on these strings)
 REJECT_QUEUE_FULL = "queue_full"
@@ -70,6 +80,8 @@ class Request:
     top_p: float = 1.0
     seed: int = 0
     deadline_s: float | None = None      # SLO relative to submission
+    sla_class: str = CLASS_INTERACTIVE   # interactive | batch
+    tenant: str | None = None            # workload attribution label
     sink: Callable[[dict], Any] | None = None
 
     # --- runtime state (engine-owned) ---
@@ -115,6 +127,8 @@ class Request:
 
     def __post_init__(self):
         self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
+        if self.sla_class not in SLA_CLASSES:
+            self.sla_class = CLASS_INTERACTIVE
         if not self.id:
             self.id = f"req_{next(_ids)}"
         if not self.submitted_at:
@@ -150,7 +164,19 @@ class Request:
 
 
 class AdmissionQueue:
-    """Bounded FIFO with reject-with-reason and prefill-budget pops."""
+    """Bounded per-class FIFOs with reject-with-reason, weighted-fair
+    pops, and a prefill-token budget per scheduling round.
+
+    Two SLO classes (`SLA_CLASSES`) each own a FIFO deque. `pop_ready`
+    serves them WEIGHTED-FAIR: a deterministic repeating pattern built
+    from `class_weights` (default 3 interactive picks per batch pick)
+    with a persistent cursor, skipping empty classes — so batch work
+    always progresses (no starvation) but interactive requests never
+    wait behind a deep batch backlog. Within a class, order is strict
+    FIFO and a block-gated head stalls only its OWN class; the other
+    class keeps flowing (`gate_blocked` names the stalled classes so
+    the engine can preempt batch slots for a gated interactive head).
+    """
 
     def __init__(
         self,
@@ -158,17 +184,40 @@ class AdmissionQueue:
         *,
         max_total_tokens: int,
         prefill_budget: int = 512,
+        class_weights: dict[str, int] | None = None,
+        class_capacity: dict[str, int] | None = None,
+        class_deadline_s: dict[str, float] | None = None,
     ):
         """`max_total_tokens` = the engine's per-slot cache length: a
         request whose prompt + max_new_tokens cannot fit is rejected at
         the door (it could never complete). `prefill_budget` caps the
-        prompt tokens admitted per `pop_ready` round."""
+        prompt tokens admitted per `pop_ready` round. `class_capacity`
+        caps one class's depth BELOW the shared capacity (a batch
+        tenant must not fill the whole queue); `class_deadline_s`
+        stamps a default deadline on submit when the request carries
+        none — the hook that makes batch work sheddable under brownout
+        even when clients never state an SLO."""
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self.max_total_tokens = max_total_tokens
         self.prefill_budget = max(1, prefill_budget)
-        self._q: deque[Request] = deque()
+        weights = {CLASS_INTERACTIVE: 3, CLASS_BATCH: 1,
+                   **(class_weights or {})}
+        # the deterministic service pattern weighted-fair rounds walk:
+        # e.g. weights {interactive:3, batch:1} -> I,I,I,B repeating
+        self._pattern: tuple[str, ...] = tuple(
+            cls for cls in SLA_CLASSES
+            for _ in range(max(1, int(weights.get(cls, 1)))))
+        self._wrr = 0   # persistent cursor into the pattern
+        self.class_capacity = dict(class_capacity or {})
+        self.class_deadline_s = dict(class_deadline_s or {})
+        self._qs: dict[str, deque[Request]] = {
+            cls: deque() for cls in SLA_CLASSES}
+        # classes whose head was denied by the block gate in the LAST
+        # pop_ready round (the engine's preempt-batch-for-interactive
+        # trigger reads this)
+        self.gate_blocked: frozenset[str] = frozenset()
         self._lock = threading.Lock()
         self._closed: str | None = None  # reject reason once closed
 
@@ -198,11 +247,19 @@ class AdmissionQueue:
         if req.prompt_len + req.max_new_tokens > self.max_total_tokens:
             req.status = "rejected"
             return False, REJECT_TOO_LONG
+        cls = req.sla_class
+        if req.deadline_s is None and self.class_deadline_s.get(cls):
+            # default class deadline, relative to the door stamp above —
+            # the deadline_at property reads submitted_at, already set
+            req.deadline_s = float(self.class_deadline_s[cls])
         with self._lock:
-            if len(self._q) >= self.capacity:
+            depth = sum(len(q) for q in self._qs.values())
+            cap = self.class_capacity.get(cls)
+            if depth >= self.capacity or \
+                    (cap is not None and len(self._qs[cls]) >= cap):
                 req.status = "rejected"
                 return False, REJECT_QUEUE_FULL
-            self._q.append(req)
+            self._qs[cls].append(req)
         return True, None
 
     # ------------------------------------------------------------- pops
@@ -222,40 +279,75 @@ class AdmissionQueue:
 
         `can_admit` is the engine's block-availability gate (paged KV
         cache): a head whose worst-case block demand does not fit stays
-        queued — and blocks everything behind it, deliberately, because
-        skipping ahead would starve large requests exactly the way the
-        prefill budget refuses to. It is consulted last, immediately
-        before the pop, so a True return (which reserves blocks) always
-        corresponds to a popped request."""
+        queued — and blocks everything behind it IN ITS CLASS,
+        deliberately, because skipping ahead would starve large
+        requests exactly the way the prefill budget refuses to. The
+        OTHER class keeps flowing, and `self.gate_blocked` names the
+        stalled classes after the round so the engine can react (a
+        gated interactive head is the preempt-batch trigger). The gate
+        is consulted last, immediately before the pop, so a True
+        return (which reserves blocks) always corresponds to a popped
+        request."""
         now = time.monotonic() if now is None else now
         admit: list[Request] = []
         expired: list[Request] = []
         budget = self.prefill_budget
+        gated: set[str] = set()
+        stalled: set[str] = set()   # gate- or budget-stalled this round
+        n_pat = len(self._pattern)
         with self._lock:
-            while self._q and len(admit) < n_slots:
-                head = self._q[0]
-                dl = head.deadline_at
-                if dl is not None and now > dl:
-                    self._q.popleft()
-                    head.status = TIMED_OUT
-                    expired.append(head)
-                    continue
-                if head.prompt_len > budget and admit:
-                    break  # next round gets a fresh budget for it
-                if can_admit is not None and not can_admit(head):
-                    # pool pressure: wait for blocks to free up. Stamp
-                    # the FIRST denial so the engine can split this
-                    # head's wait into FIFO time vs block-gate time.
-                    if head.gate_blocked_at is None:
-                        head.gate_blocked_at = now
+            while len(admit) < n_slots:
+                chosen: str | None = None
+                step = 0
+                for off in range(n_pat):
+                    cls = self._pattern[(self._wrr + off) % n_pat]
+                    if cls in stalled:
+                        continue
+                    q = self._qs[cls]
+                    while q:   # expire this class's head(s) first
+                        head = q[0]
+                        dl = head.deadline_at
+                        if dl is not None and now > dl:
+                            q.popleft()
+                            head.status = TIMED_OUT
+                            expired.append(head)
+                            continue
+                        break
+                    if not q:
+                        continue
+                    head = q[0]
+                    if head.prompt_len > budget and admit:
+                        # this class waits for next round's fresh
+                        # budget; the other class may still fit
+                        stalled.add(cls)
+                        continue
+                    if can_admit is not None and not can_admit(head):
+                        # pool pressure: this class waits for blocks.
+                        # Stamp the FIRST denial so the engine can
+                        # split this head's wait into FIFO time vs
+                        # block-gate time.
+                        if head.gate_blocked_at is None:
+                            head.gate_blocked_at = now
+                        stalled.add(cls)
+                        gated.add(cls)
+                        continue
+                    chosen = cls
+                    step = off
                     break
-                self._q.popleft()
+                if chosen is None:
+                    break
+                head = self._qs[chosen].popleft()
                 head.status = "active"
                 head.admitted_at = now
                 admit.append(head)
                 budget -= head.prompt_len
+                # the cursor advances past the pattern slot just
+                # served, so class service stays weighted across
+                # rounds, not just within one
+                self._wrr = (self._wrr + step + 1) % n_pat
                 if budget <= 0:
                     break
+            self.gate_blocked = frozenset(gated)
         return admit, expired
 
     def push_front(self, req: Request) -> None:
@@ -266,7 +358,7 @@ class AdmissionQueue:
         req.status = "queued"
         req.enqueued_at = time.monotonic()
         with self._lock:
-            self._q.appendleft(req)
+            self._qs[req.sla_class].appendleft(req)
 
     def close(self, reason: str = REJECT_DRAINING) -> None:
         """Shut the door: every later `submit` rejects with `reason`.
@@ -280,28 +372,42 @@ class AdmissionQueue:
         return self._closed is not None
 
     def shed_doomed(self, now: float | None = None,
-                    est_wait_s: float = 0.0) -> list[Request]:
-        """Brownout shedding, deadline-aware: remove queued requests
-        whose deadline cannot be met even if service began after the
-        current estimated wait (`deadline < now + est_wait_s`). These
-        are the CHEAPEST requests to shed — they are already doomed, so
+                    est_wait_s: float = 0.0, *,
+                    est_wait_by_class: dict[str, float] | None = None,
+                    classes: tuple[str, ...] | None = None,
+                    ) -> list[Request]:
+        """Brownout shedding, deadline-aware AND class-aware: remove
+        queued requests whose deadline cannot be met even if service
+        began after their CLASS's estimated wait. These are the
+        CHEAPEST requests to shed — they are already doomed, so
         rejecting them now costs the client a fast retry signal instead
         of a slow guaranteed timeout, and frees queue positions for
-        requests that can still win. Returned soonest-deadline first
-        (most-doomed first); requests without deadlines are never shed
-        here — with no SLO stated, the queue cannot call them hopeless."""
+        requests that can still win.
+
+        The estimate is per class (`est_wait_by_class`, falling back to
+        the scalar `est_wait_s`): the classes drain independently under
+        weighted-fair service, so a deep batch backlog's wait must
+        never doom-shed an interactive request that would actually be
+        scheduled next. `classes` restricts the sweep (the engine sheds
+        batch first and touches interactive only when batch is empty).
+        Returned soonest-deadline first (most-doomed first); requests
+        without deadlines are never shed here — with no SLO stated, the
+        queue cannot call them hopeless."""
         now = time.monotonic() if now is None else now
         shed: list[Request] = []
+        by_cls = est_wait_by_class or {}
         with self._lock:
-            alive: deque[Request] = deque()
-            for r in self._q:
-                dl = r.deadline_at
-                if dl is not None and dl < now + est_wait_s:
-                    r.status = "rejected"
-                    shed.append(r)
-                else:
-                    alive.append(r)
-            self._q = alive
+            for cls in (classes if classes is not None else SLA_CLASSES):
+                est = float(by_cls.get(cls, est_wait_s))
+                alive: deque[Request] = deque()
+                for r in self._qs[cls]:
+                    dl = r.deadline_at
+                    if dl is not None and dl < now + est:
+                        r.status = "rejected"
+                        shed.append(r)
+                    else:
+                        alive.append(r)
+                self._qs[cls] = alive
         shed.sort(key=lambda r: r.deadline_at)
         return shed
 
@@ -311,24 +417,35 @@ class AdmissionQueue:
         now = time.monotonic() if now is None else now
         expired: list[Request] = []
         with self._lock:
-            alive: deque[Request] = deque()
-            for r in self._q:
-                dl = r.deadline_at
-                if dl is not None and now > dl:
-                    r.status = TIMED_OUT
-                    expired.append(r)
-                else:
-                    alive.append(r)
-            self._q = alive
+            for cls in SLA_CLASSES:
+                alive: deque[Request] = deque()
+                for r in self._qs[cls]:
+                    dl = r.deadline_at
+                    if dl is not None and now > dl:
+                        r.status = TIMED_OUT
+                        expired.append(r)
+                    else:
+                        alive.append(r)
+                self._qs[cls] = alive
         return expired
 
     def __len__(self) -> int:
         with self._lock:
-            return len(self._q)
+            return sum(len(q) for q in self._qs.values())
 
     @property
     def depth(self) -> int:
         return len(self)
+
+    def depth_of(self, sla_class: str) -> int:
+        with self._lock:
+            return len(self._qs.get(sla_class, ()))
+
+    def depth_by_class(self) -> dict[str, int]:
+        """Per-class depths in one lock acquisition (the exposition
+        payload and `obs top`'s per-class columns read this)."""
+        with self._lock:
+            return {cls: len(q) for cls, q in self._qs.items()}
 
 
 class BrownoutGovernor:
@@ -361,19 +478,30 @@ class BrownoutGovernor:
         self.wait_low_s = wait_low_s if wait_low_s is not None \
             else wait_high_s / 2.0
         self._waits: deque[float] = deque(maxlen=max(4, window))
+        # per-class windows ride along so shed_doomed can use a CLASS's
+        # own wait estimate (a batch backlog's p95 must not doom
+        # interactive heads); the merged window stays the hysteresis
+        # signal — overload is a whole-queue condition
+        self._class_waits: dict[str, deque[float]] = {
+            cls: deque(maxlen=max(4, window)) for cls in SLA_CLASSES}
         self.active = False
 
-    def observe_wait(self, wait_s: float) -> None:
+    def observe_wait(self, wait_s: float, sla_class: str | None = None,
+                     ) -> None:
         """Feed one completed queue wait (the engine calls this at each
         pop — the only moment a wait's true length is known)."""
         self._waits.append(float(wait_s))
+        if sla_class in self._class_waits:
+            self._class_waits[sla_class].append(float(wait_s))
 
-    def wait_p95(self) -> float:
-        if not self._waits:
+    def wait_p95(self, sla_class: str | None = None) -> float:
+        win = self._waits if sla_class is None \
+            else self._class_waits.get(sla_class)
+        if not win:
             return 0.0
         from hyperion_tpu.obs.registry import percentile
 
-        return float(percentile(list(self._waits), 95))
+        return float(percentile(list(win), 95))
 
     def update(self, depth: int) -> str | None:
         """Advance the state machine; returns "enter"/"exit" on a
@@ -394,5 +522,7 @@ class BrownoutGovernor:
             # moment we recover — keeping them would re-trip the next
             # update from stale evidence
             self._waits.clear()
+            for win in self._class_waits.values():
+                win.clear()
             return "exit"
         return None

@@ -77,6 +77,19 @@ class ReplicaHandle:
     eject_reason: str | None = None
     restarts: int = 0
 
+    # --- acting-router state (PR 14) ---
+    # steered: a burning TTFT alert moved interactive traffic off this
+    # replica (batch still flows — the point is protecting the latency
+    # tier, not starving the replica). Unsteer is hysteresis-gated:
+    # `steer_clear_sweeps` counts CONSECUTIVE alert-free monitor sweeps,
+    # and only crossing the router's threshold flips steered back off.
+    steered: bool = False
+    steer_clear_sweeps: int = 0
+    # standby: spawned by the scale governor (not part of the base
+    # fleet); retiring standbys exit instead of restarting on next exit
+    standby: bool = False
+    retiring: bool = False
+
     @classmethod
     def under(cls, base_dir: str | Path, index: int) -> "ReplicaHandle":
         """The canonical layout: everything for replica i lives in

@@ -42,6 +42,18 @@ process that turns "a server" into "a deployment" (ROADMAP item 3):
     vocabulary (`queue_full` / `no_replica`) on its own stream, so
     fleet-wide saturation lands in the same doctor/diff tables as
     single-engine backpressure.
+  * **Acting on alerts** (PR 14) — the monitor does not just TALLY the
+    SLO alerts replicas report on their heartbeats, it acts on them. A
+    replica burning its TTFT budget is STEERED: interactive traffic
+    routes around it while batch keeps flowing (protect the latency
+    tier without starving the replica), and its engine is ordered into
+    a batch-class brownout over the exposition control socket. Steering
+    reverses only after `--steer-clear-sweeps` CONSECUTIVE alert-free
+    monitor sweeps — hysteresis, so a flapping alert cannot turn
+    dispatch into a lottery. Sustained burn additionally spawns standby
+    replicas up to `--max-replicas` and retires them once the fleet is
+    quiet again. Every action is a telemetry event (`router_steer`,
+    `router_scale`, `class_brownout`) that `obs doctor` narrates.
 
 Failure matrix (SERVING.md "Replica tier" has the long version):
 replica crash → supervised restart + journal replay + router failover;
@@ -70,10 +82,12 @@ from hyperion_tpu.obs.heartbeat import host_rss_mb
 from hyperion_tpu.serve.client import TERMINAL_EVENTS, ServeClient
 from hyperion_tpu.serve.metrics import RouterMetrics
 from hyperion_tpu.serve.queue import (
+    CLASS_BATCH,
     REJECT_BAD_REQUEST,
     REJECT_DRAINING,
     REJECT_NO_REPLICA,
     REJECT_QUEUE_FULL,
+    BrownoutGovernor,
 )
 from hyperion_tpu.serve.replica import READY, ReplicaHandle
 from hyperion_tpu.serve.server import _LineWriter
@@ -177,14 +191,28 @@ class RouterPolicy:
         index, deterministically). Returns (replica, meta) with the
         replica's accounting already bumped — callers MUST `release`
         when the stream ends. (None, meta) when no ready replica
-        remains outside `exclude`."""
+        remains outside `exclude`.
+
+        Steering: a replica the router marked `steered` (burning its
+        TTFT budget) is excluded for interactive requests while any
+        un-steered alternative exists — batch traffic still flows to
+        it, and with NO alternative interactive flows too (degraded
+        service beats no service). Affinity yields the same way: a
+        sticky key whose target is steered re-maps to a clean replica
+        for the latency tier."""
         with self._lock:
             key = self.affinity_key(doc)
-            meta = {"had_key": key is not None, "affinity_hit": False}
+            meta = {"had_key": key is not None, "affinity_hit": False,
+                    "steered_away": False}
             ready = [r for r in self.replicas
                      if r.state == READY and r.index not in exclude]
             if not ready:
                 return None, meta
+            if str(doc.get("class", "")) != CLASS_BATCH:
+                clear = [r for r in ready if not r.steered]
+                if clear:
+                    meta["steered_away"] = len(clear) < len(ready)
+                    ready = clear
             best = min(ready, key=lambda r: (r.load_score(), r.index))
             target = best
             if key is not None:
@@ -206,6 +234,20 @@ class RouterPolicy:
     def release(self, rep: ReplicaHandle) -> None:
         with self._lock:
             rep.inflight = max(0, rep.inflight - 1)
+
+    def add_replica(self, rep: ReplicaHandle) -> None:
+        """Admit a scale-up standby into the dispatch set (it starts in
+        STARTING and becomes dispatchable on its first serve beat, the
+        same road every base replica walks)."""
+        with self._lock:
+            self.replicas.append(rep)
+
+    def set_steered(self, rep: ReplicaHandle, on: bool) -> None:
+        """Flip steering under the dispatch lock so choose() never sees
+        a half-applied sweep."""
+        with self._lock:
+            rep.steered = on
+            rep.steer_clear_sweeps = 0
 
     # ---------------------------------------------------------- health
 
@@ -276,6 +318,13 @@ def replica_argv(args, rep: ReplicaHandle) -> list[str]:
             "--num-blocks", str(args.num_blocks),
             "--queue-capacity", str(args.queue_capacity),
             "--prefill-budget", str(args.prefill_budget),
+            "--prefill-chunk", str(getattr(args, "prefill_chunk", 0)),
+            "--interactive-weight",
+            str(getattr(args, "interactive_weight", 3)),
+            "--batch-weight", str(getattr(args, "batch_weight", 1)),
+            "--batch-capacity", str(getattr(args, "batch_capacity", 0)),
+            "--batch-deadline-s",
+            str(getattr(args, "batch_deadline_s", 0.0)),
             "--max-new-default", str(args.max_new_default),
             "--warmup-lens", args.warmup_lens,
             "--heartbeat-every", str(args.replica_heartbeat_every),
@@ -353,6 +402,19 @@ class Router:
         # fleet tally counts RAISES, not beats), the router's own SLO
         # monitor (route-level reject rate), and the exposition socket
         self._fleet_alert_seen: dict[int, set] = {}
+        # acting state (PR 14): steer hysteresis + the scale governor.
+        # The governor is the queue's own BrownoutGovernor watching the
+        # count of BURNING replicas as its "depth" — enter (>=1 burning)
+        # spawns a standby, exit (0 burning) retires one, and the
+        # hysteresis that keeps brownout from flapping keeps the fleet
+        # size from flapping too.
+        self._act = bool(getattr(args, "act", True))
+        self._steer_clear_sweeps = max(
+            1, int(getattr(args, "steer_clear_sweeps", 3)))
+        self._max_replicas = int(getattr(args, "max_replicas", 0) or 0)
+        self._scale_gov = None
+        if self._act and self._max_replicas > len(self.replicas):
+            self._scale_gov = BrownoutGovernor(depth_high=1)
         self._exporter = None
         self._slo = None
         route_budget = getattr(args, "slo_reject_rate", 0.0) or 0.0
@@ -419,7 +481,7 @@ class Router:
         def decide(rc: int) -> Decision:
             self._eject(rep, f"child exit {rc}")
             self.tracer.event("replica_exit", replica=rep.index, rc=rc)
-            if self._stopping.is_set():
+            if self._stopping.is_set() or rep.retiring:
                 return Decision.stop(0)
             rep.restarts += 1
             # restart immediately: an ejected replica costs fleet
@@ -451,6 +513,7 @@ class Router:
             "active": r.hb_active, "queue": r.hb_queue,
             "inflight": r.inflight, "restarts": r.restarts,
             "alerts": list(r.hb_alerts),
+            "steered": r.steered, "standby": r.standby,
         } for r in self.replicas]
         own = (self._slo.active_names() if self._slo is not None else [])
         # the aggregated list counts READY replicas only (a dead
@@ -470,6 +533,14 @@ class Router:
             "draining": self._stopping.is_set(),
             "alerts": own + fleet,
             "replicas": reps,
+            # what the acting layer is doing RIGHT NOW — `obs top`'s
+            # act column and the doctor's router-action narration
+            "act": {
+                "enabled": self._act,
+                "steered": [r.index for r in self.replicas if r.steered],
+                "fleet": len(self.replicas),
+                "max_replicas": self._max_replicas,
+            },
             "metrics": self.metrics.reg.snapshot(),
             "windows": self.metrics.reg.windowed_snapshot(window_s),
             # host memory only: the router holds no params and no KV
@@ -503,6 +574,139 @@ class Router:
             self._fleet_alert_seen[rep.index] = cur
         self.metrics.on_fleet_alerts(new_raises)
         return fleet_alerts
+
+    # --------------------------------------------- acting on alerts
+
+    @staticmethod
+    def _burning(rep: ReplicaHandle) -> bool:
+        """A READY replica reporting any TTFT-family SLO alert on its
+        last beat — the one signal that says the LATENCY tier is being
+        hurt there right now (reject/availability alerts have their own
+        remedies: failover and restart already handle those)."""
+        return rep.state == READY and any("ttft" in a for a in rep.hb_alerts)
+
+    def _order_class_brownout(self, rep: ReplicaHandle,
+                              active: bool) -> None:
+        """One control verb to one replica's engine over its exposition
+        socket: clamp/shed the batch tier (or lift the order). Best-
+        effort — a replica that predates the verb, or is mid-restart,
+        simply doesn't ack; steering alone still protects the latency
+        tier, and the event records `acked` either way so the doctor
+        can tell an ignored order from an obeyed one."""
+        from hyperion_tpu.obs.export import (
+            exposition_path,
+            request_control,
+        )
+
+        resp = None
+        try:
+            resp = request_control(
+                exposition_path(rep.heartbeat_path),
+                {"cmd": "class_brownout", "active": active},
+                timeout_s=2.0)
+        except Exception:  # noqa: BLE001 — an order must never kill
+            pass           # the monitor thread
+        acked = isinstance(resp, dict) and resp.get("status") == "ok"
+        self.metrics.on_class_brownout(active)
+        self.tracer.event("class_brownout", replica=rep.index,
+                          active=active, acked=acked)
+        self._log(f"[route] replica {rep.index} class_brownout "
+                  f"{'on' if active else 'off'}"
+                  f"{'' if acked else ' (no ack)'}")
+
+    def _sweep_actions(self) -> int:
+        """The acting half of the monitor sweep: steer/unsteer per
+        replica off its heartbeat alerts, then feed the burning count
+        to the scale governor. Returns the burning count (rides the
+        router heartbeat). No-op under --no-act — the router then
+        observes and tallies exactly as PR 13 built it."""
+        if not self._act:
+            return 0
+        burning = 0
+        for rep in self.replicas:
+            if self._burning(rep):
+                burning += 1
+                if not rep.steered:
+                    self.policy.set_steered(rep, True)
+                    self.metrics.on_steer(True)
+                    self.tracer.event("router_steer", replica=rep.index,
+                                      on=True,
+                                      alerts=list(rep.hb_alerts))
+                    self._log(f"[route] replica {rep.index} steered: "
+                              f"{','.join(rep.hb_alerts)}")
+                    self._order_class_brownout(rep, True)
+                else:
+                    rep.steer_clear_sweeps = 0
+            elif rep.steered and rep.state == READY:
+                # hysteresis: only CONSECUTIVE alert-free sweeps of a
+                # beating replica count toward unsteer — an ejected
+                # replica's silence is not evidence of recovery
+                rep.steer_clear_sweeps += 1
+                if rep.steer_clear_sweeps >= self._steer_clear_sweeps:
+                    self.policy.set_steered(rep, False)
+                    self.metrics.on_steer(False)
+                    self.tracer.event("router_steer", replica=rep.index,
+                                      on=False)
+                    self._log(f"[route] replica {rep.index} unsteered "
+                              f"after {self._steer_clear_sweeps} clean "
+                              f"sweeps")
+                    self._order_class_brownout(rep, False)
+        self.metrics.observe_steered(
+            sum(1 for r in self.replicas if r.steered))
+        if self._scale_gov is not None and not self._stopping.is_set():
+            tr = self._scale_gov.update(burning)
+            if tr == "enter":
+                self._scale_up()
+            elif tr == "exit":
+                self._scale_down()
+        return burning
+
+    def _scale_up(self) -> None:
+        """Spawn one standby replica (the next index under the base
+        dir) — same supervisor road as the base fleet, dispatchable on
+        its first serve beat."""
+        idx = len(self.replicas)
+        if idx >= self._max_replicas:
+            return
+        rep = ReplicaHandle.under(Path(self.args.base_dir), idx)
+        rep.standby = True
+        rep.dir.mkdir(parents=True, exist_ok=True)
+        self.replicas.append(rep)
+        self.policy.add_replica(rep)
+        t = threading.Thread(target=self._supervise_one, args=(rep,),
+                             name=f"replica{rep.index}-sup", daemon=True)
+        t.start()
+        self._sup_threads.append(t)
+        self.metrics.on_scale(True)
+        self.tracer.event("router_scale", direction="up",
+                          replica=rep.index, fleet=len(self.replicas))
+        self._log(f"[route] scale up: standby replica {rep.index} "
+                  f"spawning ({len(self.replicas)}/{self._max_replicas})")
+
+    def _scale_down(self) -> None:
+        """Retire the youngest live standby: eject it from dispatch
+        (in-flight relays fail over exactly like a crash — exactly-once
+        delivery holds), terminate the child, and let its supervisor's
+        decide() see `retiring` and stop instead of restarting."""
+        rep = next((r for r in reversed(self.replicas)
+                    if r.standby and not r.retiring), None)
+        if rep is None:
+            return
+        rep.retiring = True
+        self._eject(rep, "retired (scale-down)")
+        proc = self._procs.get(rep.index)
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+        self.metrics.on_scale(False)
+        self.tracer.event("router_scale", direction="down",
+                          replica=rep.index,
+                          fleet=sum(1 for r in self.replicas
+                                    if not r.retiring))
+        self._log(f"[route] scale down: standby replica {rep.index} "
+                  f"retiring")
 
     def start(self) -> None:
         self.tracer.event(
@@ -558,6 +762,7 @@ class Router:
             ready = self.policy.ready_count
             inflight = self.policy.inflight_total
             fleet_alerts = self._sweep_fleet_alerts()
+            self._sweep_actions()
             self.metrics.observe_fleet(ready, inflight,
                                        alerts_active=len(fleet_alerts))
             if self._slo is not None:
@@ -983,6 +1188,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="attach a chaos plan (testing/chaos.py grammar) "
                         "to one replica, e.g. 0:crash@tick=2 — the "
                         "kill-one-mid-stream drill")
+    # ---- acting on alerts (steer / class brownout / scale) ----
+    p.add_argument("--act", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="act on replica SLO alerts: steer interactive "
+                        "traffic off a TTFT-burning replica, order its "
+                        "engine into a batch-class brownout, and (with "
+                        "--max-replicas) scale standbys in and out "
+                        "(--no-act = observe/tally only)")
+    p.add_argument("--steer-clear-sweeps", type=int, default=3,
+                   help="consecutive alert-free monitor sweeps before "
+                        "a steered replica takes interactive traffic "
+                        "again (unsteer hysteresis)")
+    p.add_argument("--max-replicas", type=int, default=0,
+                   help="fleet ceiling for alert-driven scale-up "
+                        "(standby replicas spawn while any replica "
+                        "burns its TTFT budget, retire when the fleet "
+                        "is quiet; 0 = no scaling)")
     # ---- replica engine surface (forwarded to each child) ----
     p.add_argument("--ckpt", required=True,
                    help="gathered-export .npz every replica serves")
@@ -997,6 +1219,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default=True)
     p.add_argument("--queue-capacity", type=int, default=64)
     p.add_argument("--prefill-budget", type=int, default=512)
+    p.add_argument("--prefill-chunk", type=int, default=0)
+    p.add_argument("--interactive-weight", type=int, default=3)
+    p.add_argument("--batch-weight", type=int, default=1)
+    p.add_argument("--batch-capacity", type=int, default=0)
+    p.add_argument("--batch-deadline-s", type=float, default=0.0)
     p.add_argument("--max-new-default", type=int, default=32)
     p.add_argument("--warmup-lens", default="8,32")
     p.add_argument("--replica-heartbeat-every", type=int, default=5,

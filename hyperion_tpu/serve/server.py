@@ -15,7 +15,11 @@ Two transports over one wire protocol, one JSON object per line:
 Request line:
     {"id": "r1", "prompt": "text", "max_new_tokens": 32,
      "temperature": 0.0, "top_k": 0, "top_p": 1.0, "seed": 0,
-     "deadline_s": 5.0}
+     "deadline_s": 5.0, "class": "interactive", "tenant": "team-a"}
+`class` is the SLO class (`interactive` default | `batch` — the tier
+that absorbs sheds/clamps/preemption first under pressure); `tenant`
+is a free-form attribution label `obs doctor` uses to name a hostile
+workload.
 `prompt_ids` (a raw int list) substitutes for `prompt` when no
 tokenizer is loaded. Every response line carries the request id:
     {"id": "r1", "event": "token", "token": 17, "text": "..."}
@@ -119,6 +123,9 @@ def parse_request_line(line: str, tok=None, defaults: dict | None = None):
             seed=int(doc.get("seed", 0)),
             deadline_s=(float(doc["deadline_s"])
                         if doc.get("deadline_s") is not None else None),
+            sla_class=str(doc.get("class", "interactive")),
+            tenant=(str(doc["tenant"])
+                    if doc.get("tenant") is not None else None),
         )
     except (TypeError, ValueError) as e:
         return {"id": doc.get("id"), "event": "error",
@@ -356,8 +363,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="prompt tokens admitted per scheduling round — "
                         "caps how long one giant prompt can stall "
                         "in-flight decode ticks")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="chunked prefill: prompts longer than this are "
+                        "split into fixed-size chunks interleaved with "
+                        "decode ticks, so one giant prompt can't spike "
+                        "co-running slots' TTFT (0 = off). One static "
+                        "chunk shape = exactly one extra executable; "
+                        "temp-0 output stays bit-identical")
     p.add_argument("--max-new-default", type=int, default=32,
                    help="max_new_tokens when a request omits it")
+    # ---- SLO classes (serve/queue.py) ----
+    p.add_argument("--interactive-weight", type=int, default=3,
+                   help="weighted-fair admission: interactive slots per "
+                        "round-robin cycle (vs --batch-weight)")
+    p.add_argument("--batch-weight", type=int, default=1,
+                   help="weighted-fair admission: batch slots per "
+                        "round-robin cycle")
+    p.add_argument("--batch-capacity", type=int, default=0,
+                   help="separate queue bound for class=batch requests "
+                        "(0 = share --queue-capacity); a batch flood "
+                        "then rejects batch, never interactive")
+    p.add_argument("--batch-deadline-s", type=float, default=0.0,
+                   help="default admission deadline for class=batch "
+                        "requests that omit deadline_s (0 = none)")
     # ---- speculative decoding (serve/draft.py) ----
     p.add_argument("--spec-k", type=int, default=0,
                    help="speculative decoding: draft tokens verified "
@@ -623,6 +651,11 @@ def main(argv=None) -> int:
             slots=args.slots, max_len=args.max_len, eos_id=eos_id,
             queue_capacity=args.queue_capacity,
             prefill_budget=args.prefill_budget,
+            prefill_chunk=args.prefill_chunk,
+            interactive_weight=args.interactive_weight,
+            batch_weight=args.batch_weight,
+            batch_capacity=args.batch_capacity,
+            batch_deadline_s=args.batch_deadline_s,
             block_size=args.block_size, num_blocks=args.num_blocks,
             prefix_cache=args.prefix_cache,
             spec_k=args.spec_k, draft=args.draft,

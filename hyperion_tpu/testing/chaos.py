@@ -26,6 +26,15 @@ or `HYPERION_CHAOS`:
                          draining (dead socket, wedged pipe) and
                          backpressures the serve loop from the client
                          side rather than the device side
+    slowloris@tenant=NAME:SECS
+                         adversarial tenant: EVERY token delivered to a
+                         request tagged `tenant=NAME` sleeps SECS — a
+                         client that reads one byte at a time forever.
+                         Standing (exempt from the fire-once record):
+                         the attack is sustained drain starvation, and
+                         the defense under test is workload isolation —
+                         co-running tenants' TTFT/TPOT must hold while
+                         `obs doctor` names the offender
     crash@tick=N         hard `os._exit` before serve tick N — no
                          signal handlers, no atexit, no flushes beyond
                          what already hit the kernel: the ugliest
@@ -85,16 +94,17 @@ _CKPT_CLAUSE = re.compile(r"^corrupt_ckpt@latest$")
 _IO_CLAUSE = re.compile(r"^io_fail@p=([0-9.]+)$")
 _JOURNAL_CLAUSE = re.compile(r"^journal_io_fail@p=([0-9.]+)$")
 _POISON_CLAUSE = re.compile(r"^poison_request@id=([\w.:-]+)$")
+_TENANT_CLAUSE = re.compile(r"^slowloris@tenant=([\w.:-]+):([0-9.]+)$")
 
 
 @dataclasses.dataclass(frozen=True)
 class Fault:
-    kind: str                 # kill | sigterm | nan_loss | stall | slow_client | crash | corrupt_ckpt | io_fail | journal_io_fail | poison_request
+    kind: str                 # kill | sigterm | nan_loss | stall | slow_client | slowloris | crash | corrupt_ckpt | io_fail | journal_io_fail | poison_request
     step: int | None = None   # trainer step OR serve tick, per `unit`
-    secs: float = 0.0         # stall / slow_client duration
+    secs: float = 0.0         # stall / slow_client / slowloris duration
     p: float = 0.0            # io_fail / journal_io_fail probability
     unit: str = "step"        # "step" (trainer loop) | "tick" (serve loop)
-    rid: str | None = None    # poison_request target id
+    rid: str | None = None    # poison_request id OR slowloris tenant
 
     @property
     def key(self) -> str:
@@ -107,6 +117,8 @@ class Fault:
             return "corrupt_ckpt@latest"
         if self.kind == "poison_request":
             return f"poison_request@id={self.rid}"
+        if self.kind == "slowloris":
+            return f"slowloris@tenant={self.rid}:{self.secs}"
         return f"{self.kind}@{self.unit}={self.step}"
 
 
@@ -146,14 +158,17 @@ def parse_plan(spec: str) -> list[Fault]:
             faults.append(Fault("journal_io_fail", p=p))
         elif m := _POISON_CLAUSE.match(clause):
             faults.append(Fault("poison_request", rid=m.group(1)))
+        elif m := _TENANT_CLAUSE.match(clause):
+            faults.append(Fault("slowloris", rid=m.group(1),
+                                secs=float(m.group(2))))
         else:
             raise ValueError(
                 f"unknown chaos clause {clause!r} (grammar: kill@step=N, "
                 "sigterm@step=N, nan_loss@step=N, stall@step=N:SECS, "
                 "kill@tick=N, sigterm@tick=N, stall@tick=N:SECS, "
-                "slow_client@tick=N:SECS, crash@tick=N, "
-                "journal_io_fail@p=X, poison_request@id=ID, "
-                "corrupt_ckpt@latest, io_fail@p=X)")
+                "slow_client@tick=N:SECS, slowloris@tenant=NAME:SECS, "
+                "crash@tick=N, journal_io_fail@p=X, "
+                "poison_request@id=ID, corrupt_ckpt@latest, io_fail@p=X)")
     return faults
 
 
@@ -171,6 +186,7 @@ class ChaosPlan:
         self._rng = np.random.default_rng(seed)
         self._jrng = np.random.default_rng(seed + 1)  # journal_io_fail
         self._fired: set[str] = set()
+        self._announced: set[str] = set()  # standing faults log once
         if self.state_path is not None and self.state_path.exists():
             try:
                 self._fired = set(
@@ -256,15 +272,25 @@ class ChaosPlan:
             elif f.kind == "stall":
                 time.sleep(f.secs)
 
-    def on_client(self, tick: int) -> None:
-        """slow_client@tick=N:SECS — fired inside the engine's token
-        DELIVERY path: the consumer side wedges (dead socket, blocked
-        pipe) while the device side is healthy, backpressuring the
-        serve loop from the client edge."""
+    def on_client(self, tick: int, req=None) -> None:
+        """Token-delivery-path faults. slow_client@tick=N:SECS — the
+        consumer side wedges once (dead socket, blocked pipe) while the
+        device side is healthy, backpressuring the serve loop from the
+        client edge. slowloris@tenant=NAME:SECS — a STANDING delay on
+        every token delivered to `req`s tagged with that tenant (the
+        adversarial client that reads one byte at a time), announced
+        once but exempt from the fire record: sustained starvation is
+        the attack, isolation of everyone else is the test."""
         for f in self.faults:
             if f.kind == "slow_client" and f.unit == "tick" \
                     and f.step == tick and self._mark(f):
                 print(f"[chaos] firing {f.key}", flush=True)
+                time.sleep(f.secs)
+            elif f.kind == "slowloris" and req is not None \
+                    and getattr(req, "tenant", None) == f.rid:
+                if f.key not in self._announced:
+                    self._announced.add(f.key)
+                    print(f"[chaos] firing {f.key} (standing)", flush=True)
                 time.sleep(f.secs)
 
     def on_request(self, request_id: str) -> None:

@@ -450,3 +450,142 @@ assert drafted > 0, "spec run never drafted — did --spec-k reach the engine?"
 print(f"[serve_smoke] OK: speculative round trip — {len(got)} tokens "
       f"bit-identical to the sequential run ({drafted} drafted)")
 PY
+
+# 10. adversarial tenants + the acting router: a 2-replica fleet with a
+#     1ms TTFT objective (guaranteed to burn), a slowloris tenant whose
+#     chaos stall ties up engine ticks, and a batch-tenant flood riding
+#     along. The interactive stream must stay bit-identical to a quiet
+#     single-engine run; the router must ACT (>=1 router_steer and >=1
+#     class_brownout on its stream); `obs doctor` must name the
+#     adversarial tenants and narrate the router's actions.
+printf '%s\n' \
+  '{"id":"int0","prompt_ids":[3,4,5,6],"max_new_tokens":4}' \
+  '{"id":"int1","prompt_ids":[4,4,5,6],"max_new_tokens":4}' \
+  '{"id":"int2","prompt_ids":[5,4,5,6],"max_new_tokens":4}' \
+  '{"id":"int3","prompt_ids":[6,4,5,6],"max_new_tokens":4}' \
+  '{"id":"int4","prompt_ids":[7,4,5,6],"max_new_tokens":4}' \
+  '{"id":"int5","prompt_ids":[8,4,5,6],"max_new_tokens":4}' \
+  '{"id":"int6","prompt_ids":[9,4,5,6],"max_new_tokens":4}' \
+  '{"id":"int7","prompt_ids":[10,4,5,6],"max_new_tokens":4}' \
+  | python -m hyperion_tpu.cli.main serve \
+      --ckpt "$WORK/llama.npz" --no-tokenizer \
+      --max-len 64 --slots 2 --warmup-lens 8 \
+      > "$WORK/adv_ref.jsonl"
+
+python -m hyperion_tpu.cli.main route \
+    --replicas 2 --min-ready 2 --ckpt "$WORK/llama.npz" --no-tokenizer \
+    --base-dir "$WORK/fleet_adv" --max-len 64 --slots 2 \
+    --warmup-lens 8 --replica-heartbeat-every 1 \
+    --socket "$WORK/route_adv.sock" \
+    --prefill-chunk 16 --interactive-weight 3 --batch-weight 1 \
+    --slo-ttft-p99-ms 1 --slo-fast-s 30 \
+    --steer-clear-sweeps 3 \
+    --replica-chaos '0:slowloris@tenant=adv_slow:0.05' \
+    2> "$WORK/route_adv.log" &
+ROUTE_ADV_PID=$!
+trap 'kill -TERM "$ROUTE_ADV_PID" 2>/dev/null || true' EXIT
+
+python - "$WORK" <<'PY'
+import json
+import sys
+import time
+from pathlib import Path
+
+from hyperion_tpu.serve.client import ServeClient
+
+work = Path(sys.argv[1])
+sock = work / "route_adv.sock"
+t0 = time.monotonic()
+while not sock.exists():
+    assert time.monotonic() - t0 < 240, "router socket never appeared"
+    time.sleep(0.2)
+
+
+def ask(doc):
+    with ServeClient(str(sock)) as c:
+        return c.generate(**doc)
+
+
+# the hostile co-tenants: a batch flood from one tenant, a slowloris
+# tenant whose deliveries stall replica 0's engine ticks (chaos)
+for i in range(6):
+    res = ask({"id": f"adv{i}", "prompt_ids": [5 + i, 6, 7, 8],
+               "max_new_tokens": 6, "class": "batch",
+               "tenant": "adv_burst"})
+    assert res["final"]["event"] == "done", res
+res = ask({"id": "slow0", "prompt_ids": [9, 6, 7, 8],
+           "max_new_tokens": 3, "tenant": "adv_slow"})
+assert res["final"]["event"] == "done", res
+
+# the interactive tier, same docs as the quiet single-engine reference
+got = {}
+for i in range(8):
+    res = ask({"id": f"int{i}", "prompt_ids": [3 + i, 4, 5, 6],
+               "max_new_tokens": 4, "tenant": "alice"})
+    assert res["final"]["event"] == "done", res
+    got[f"int{i}"] = res["tokens"]
+
+ref = {}
+for line in open(work / "adv_ref.jsonl"):
+    rec = json.loads(line)
+    if rec.get("event") == "token" and rec.get("token") is not None:
+        ref.setdefault(rec["id"], []).append(rec["token"])
+assert got == ref, (
+    f"interactive stream diverged under hostile co-tenancy: "
+    f"{got} != {ref}")
+
+# the router must ACT: steer + class-brownout events on its stream
+tele = work / "fleet_adv" / "telemetry.jsonl"
+deadline = time.monotonic() + 120
+while True:
+    names = []
+    if tele.exists():
+        for line in tele.read_text().splitlines():
+            try:
+                names.append(json.loads(line).get("name"))
+            except json.JSONDecodeError:
+                pass
+    if "router_steer" in names and "class_brownout" in names:
+        break
+    assert time.monotonic() < deadline, (
+        f"router never acted on the TTFT burn: events={set(names)}")
+    time.sleep(0.5)
+print("[serve_smoke] adversarial drive done: interactive bit-identical, "
+      "router_steer + class_brownout observed")
+PY
+
+kill -TERM "$ROUTE_ADV_PID" 2>/dev/null || true
+wait "$ROUTE_ADV_PID" || true
+trap - EXIT
+
+python -m hyperion_tpu.cli.main obs doctor "$WORK/fleet_adv" --json \
+  > "$WORK/adv_router_doctor.json"
+python -m hyperion_tpu.cli.main obs doctor "$WORK/fleet_adv/replica_0" \
+  --json > "$WORK/adv_rep0_doctor.json"
+python -m hyperion_tpu.cli.main obs doctor "$WORK/fleet_adv/replica_1" \
+  --json > "$WORK/adv_rep1_doctor.json"
+
+python - "$WORK" <<'PY'
+import json
+import sys
+from pathlib import Path
+
+work = Path(sys.argv[1])
+router = json.loads((work / "adv_router_doctor.json").read_text())
+acts = router.get("router_actions") or []
+assert any("steered" in a for a in acts), (
+    f"doctor narrated no steering: {acts} / {router['reason']}")
+assert any("brownout" in a for a in acts), (
+    f"doctor narrated no brownout order: {acts}")
+tenants = set()
+for name in ("adv_rep0_doctor.json", "adv_rep1_doctor.json"):
+    d = json.loads((work / name).read_text())
+    tenants |= {t["tenant"] for t in d.get("tenants") or []}
+assert "adv_burst" in tenants and "adv_slow" in tenants, (
+    f"doctor never named the adversarial tenants: {tenants}")
+print(f"[serve_smoke] OK: acting router — doctor narrates "
+      f"{len(acts)} action line(s) and names tenants "
+      f"{sorted(tenants)}")
+PY
+
+echo "[serve_smoke] all legs passed"

@@ -296,6 +296,87 @@ class TestSpeculationIncident:
         assert "serve speculation" not in doctor.render_markdown(d)
 
 
+class TestTenantAttributionAndRouterActions:
+    """PR 14: when adversarial tenants drive the pressure, the doctor
+    NAMES the offending tenant from the admit/shed event trail; and
+    the acting router's telemetry (router_steer / class_brownout /
+    router_scale) rolls up into one narrated line."""
+
+    def _run(self, tmp_path, events):
+        clk, wall = FakeClock(100.0), FakeClock(1_000.0)
+        t = Tracer(tmp_path / "telemetry.jsonl", run="r1", proc=0,
+                   clock=clk, wall=wall)
+        t.event("serve_start")
+        for name, kw in events:
+            clk.advance(0.1)
+            wall.advance(0.1)
+            t.event(name, **kw)
+        t.event("serve_end")
+        t.close()
+        return doctor.diagnose(tmp_path, now=1_100.0)
+
+    def test_offending_tenant_is_named(self, tmp_path):
+        d = self._run(tmp_path, [
+            ("request_admitted", {"request": "a0", "tenant": "adv_burst",
+                                  "sla_class": "batch"}),
+            ("request_admitted", {"request": "a1", "tenant": "adv_burst",
+                                  "sla_class": "batch"}),
+            ("request_rejected", {"request": "a2", "tenant": "adv_burst",
+                                  "sla_class": "batch", "shed": True,
+                                  "reason": "shed_deadline"}),
+            ("request_admitted", {"request": "u0", "tenant": "alice",
+                                  "sla_class": "interactive"}),
+        ])
+        assert d["tenants"][0]["tenant"] == "adv_burst"
+        assert d["tenants"][0]["shed"] == 1
+        assert any("adv_burst" in s for s in d["tenant_incidents"])
+        assert "adv_burst" in d["reason"]
+        md = doctor.render_markdown(d)
+        assert "`adv_burst`" in md and "**offender**" in md
+        # the civilian tenant renders unflagged
+        assert "`alice`" in md
+        assert md.count("**offender**") == 1
+
+    def test_untagged_run_makes_no_tenant_claim(self, tmp_path):
+        d = self._run(tmp_path, [
+            ("request_admitted", {"request": "a0",
+                                  "sla_class": "interactive"}),
+        ])
+        assert d["tenants"] == [] and d["tenant_incidents"] == []
+        assert "tenant" not in d["reason"]
+
+    def test_router_actions_are_narrated(self, tmp_path):
+        d = self._run(tmp_path, [
+            ("router_steer", {"replica": 1, "on": True,
+                              "alerts": ["ttft_p99"]}),
+            ("class_brownout", {"replica": 1, "active": True,
+                                "acked": True}),
+            ("router_scale", {"direction": "up", "replica": 2,
+                              "fleet": 3}),
+            ("router_steer", {"replica": 1, "on": False}),
+            ("class_brownout", {"replica": 1, "active": False,
+                                "acked": True}),
+            ("router_scale", {"direction": "down", "replica": 2,
+                              "fleet": 2}),
+        ])
+        acts = d["router_actions"]
+        assert len(acts) == 3
+        assert any("replica(s) 1" in a and "all reversed" in a
+                   for a in acts)
+        assert any("brownout ordered 1x, lifted 1x" in a for a in acts)
+        assert any("1 standby spawn(s), 1 retire(s)" in a for a in acts)
+        assert "router actions:" in d["reason"]
+        assert "router action" in doctor.render_markdown(d)
+
+    def test_unreversed_steer_is_called_out(self, tmp_path):
+        d = self._run(tmp_path, [
+            ("router_steer", {"replica": 0, "on": True,
+                              "alerts": ["ttft_p99"]}),
+        ])
+        assert any("still steered at the end" in a
+                   for a in d["router_actions"])
+
+
 def write_rss_run(path, run: str, series):
     """A finished serve-shaped run whose snapshots carry the host RSS
     gauge as a SERIES — the evidence `doctor` reads for the host-leak

@@ -498,6 +498,33 @@ class TestObsTop:
         assert top_mod.main([str(live_fleet), "--json"]) == 2
         assert "--once" in capsys.readouterr().err
 
+    def test_row_keys_pin_isolation_columns(self):
+        """PR 14 column contract: per-class queue depth and the `act`
+        cell are part of ROW_KEYS (CI parses --json rows by key), and
+        the exposition mapping fills them."""
+        for key in ("queue_interactive", "queue_batch", "act"):
+            assert key in top_mod.ROW_KEYS
+        row = {k: None for k in top_mod.ROW_KEYS}
+        exp = {"phase": "serve", "tick": 5, "active": 1, "slots": 2,
+               "queue": 3, "queue_by_class": {"interactive": 1,
+                                              "batch": 2},
+               "act": {"class_brownout": True, "chunking": 2}}
+        out = top_mod._row_from_exposition(dict(row), exp)
+        assert out["queue_interactive"] == 1 and out["queue_batch"] == 2
+        assert out["act"] == "cbrown+chunk:2"
+        # a router-side payload renders steering + fleet posture
+        assert top_mod._act_cell(
+            {"enabled": True, "steered": [0, 2], "fleet": 3,
+             "max_replicas": 4}) == "steer:0,2+fleet:3/4"
+        # carrying the payload while idle reads '-', no payload None
+        assert top_mod._act_cell({"enabled": True, "steered": []}) == "-"
+        assert top_mod._act_cell({}) is None
+        # the render pipeline accepts the new columns end to end
+        out.update(name="replica 0", dir="x", source="socket",
+                   state="live", alerts=[], age_s=0.0)
+        text = top_mod.render([out], "x", window_s=60.0, color=False)
+        assert "q i/b" in text and "cbrown+chunk:2" in text
+
     def test_smoke_script_top_invocation_parses(self):
         """Flag-drift guard (the capture-script pattern): the smoke
         script's `obs top` probe must parse against the real arg
@@ -595,6 +622,32 @@ class TestAlertConsumers:
         # and fewer alerts is an improvement, not a regression
         d = obs_diff.diff(b, a)
         assert "serve_alerts_raised" not in d["regressions"]
+
+    def test_diff_gates_isolation_keys(self):
+        """PR 14 gates: interactive TTFT p99 and batch shed rate are
+        first-class gated metrics (both lower-is-better), fed from the
+        serving probe's @class dimension."""
+        from hyperion_tpu.obs import diff as obs_diff
+
+        assert obs_diff.METRICS["serve_interactive_ttft_p99_ms"] == "lower"
+        assert obs_diff.METRICS["serve_batch_shed_rate"] == "lower"
+        row = {"metric": "serving", "value": 1.0,
+               "serving": {"tokens_per_s": 100.0,
+                           "interactive_ttft_p99_ms": 5.0,
+                           "batch_shed_rate": 0.0}}
+        worse = {"metric": "serving", "value": 1.0,
+                 "serving": {"tokens_per_s": 100.0,
+                             "interactive_ttft_p99_ms": 50.0,
+                             "batch_shed_rate": 0.5}}
+        a = {"label": "a", "metrics": obs_diff.normalize(row)}
+        b = {"label": "b", "metrics": obs_diff.normalize(worse)}
+        assert a["metrics"]["serve_interactive_ttft_p99_ms"] == 5.0
+        assert a["metrics"]["serve_batch_shed_rate"] == 0.0
+        d = obs_diff.diff(a, b)
+        assert "serve_interactive_ttft_p99_ms" in d["regressions"]
+        assert "serve_batch_shed_rate" in d["regressions"]
+        d = obs_diff.diff(b, a)  # the improvement direction stays quiet
+        assert "serve_interactive_ttft_p99_ms" not in d["regressions"]
 
     def test_diff_json_stable_keys(self, tmp_path, capsys):
         """The machine-readable satellite: `obs diff --json` keys are

@@ -904,3 +904,116 @@ class TestLiveFleetObservability:
         (tr,) = mon.evaluate()
         assert tr["kind"] == "raised" and tr["alert"] == "route_reject_rate"
         assert tr["fast"] == pytest.approx(1 / 3)
+
+# ------------------------------------------------- acting on alerts
+
+
+class TestActingRouter:
+    """PR 14: the router ACTS on the alerts it tallies — steers
+    interactive traffic off TTFT-burning replicas, orders batch-class
+    brownouts, and scales standbys — all as pure host logic over
+    fabricated heartbeats. Zero jit compiles, zero child processes."""
+
+    def test_steered_replica_skipped_for_interactive_only(self, tmp_path):
+        pol = _ready_policy(tmp_path, n=2)
+        pol.set_steered(pol.replicas[0], True)
+        rep, meta = pol.choose({"prompt_ids": [1]})
+        assert rep.index == 1 and meta["steered_away"]
+        # batch traffic still flows to the steered replica (it is the
+        # least-loaded one — interactive was just moved off it)
+        rep_b, meta_b = pol.choose({"class": "batch",
+                                    "prompt_ids": [1]})
+        assert rep_b.index == 0 and not meta_b["steered_away"]
+        # every replica steered: interactive falls back to the full
+        # ready set rather than refusing service
+        pol.set_steered(pol.replicas[1], True)
+        rep2, meta2 = pol.choose({"prompt_ids": [2]})
+        assert rep2 is not None and not meta2["steered_away"]
+
+    def test_sweep_steers_on_ttft_alert_with_hysteresis(self, tmp_path):
+        router = _mk_router(tmp_path, tmp_path / "unused.py", n=2)
+        r0, r1 = router.replicas
+        r0.state = READY
+        r1.state = READY
+        r0.hb_alerts = ("ttft_p99",)
+        assert router._sweep_actions() == 1
+        assert r0.steered and not r1.steered
+        s = router.metrics.summary()
+        assert s["steers"] == 1 and s["steered_now"] == 1
+        assert s["class_brownouts"] == 1  # ordered (no ack — no child)
+        assert router.exposition()["act"]["steered"] == [0]
+        # still burning: steering is idempotent, no double count
+        assert router._sweep_actions() == 1
+        assert router.metrics.summary()["steers"] == 1
+        # alert clears: unsteer only after N CONSECUTIVE clean sweeps
+        r0.hb_alerts = ()
+        router._sweep_actions()
+        router._sweep_actions()
+        assert r0.steered  # 2 of 3
+        r0.hb_alerts = ("ttft_p99",)  # relapse resets the count
+        router._sweep_actions()
+        r0.hb_alerts = ()
+        router._sweep_actions()
+        router._sweep_actions()
+        assert r0.steered
+        router._sweep_actions()  # third consecutive clean sweep
+        assert not r0.steered
+        s = router.metrics.summary()
+        assert s["unsteers"] == 1 and s["steered_now"] == 0
+
+    def test_ejected_silence_is_not_recovery(self, tmp_path):
+        router = _mk_router(tmp_path, tmp_path / "unused.py", n=2,
+                            steer_clear_sweeps=1)
+        r0, _ = router.replicas
+        r0.state = READY
+        r0.hb_alerts = ("ttft_p99",)
+        router._sweep_actions()
+        assert r0.steered
+        # the replica dies with the alert latched: its silence must
+        # not count toward unsteering
+        r0.state = EJECTED
+        r0.hb_alerts = ()
+        for _ in range(3):
+            router._sweep_actions()
+        assert r0.steered
+        r0.state = READY  # readmitted and clean: NOW it unsteers
+        router._sweep_actions()
+        assert not r0.steered
+
+    def test_scale_governor_spawns_and_retires_standby(self, tmp_path):
+        router = _mk_router(tmp_path, tmp_path / "unused.py", n=2,
+                            max_replicas=3)
+        router._supervise_one = lambda rep: None  # no real children
+        r0, r1 = router.replicas
+        r0.state = READY
+        r1.state = READY
+        assert router._scale_gov is not None
+        r0.hb_alerts = ("ttft_p99",)
+        router._sweep_actions()  # burning=1: governor enters, scale up
+        assert len(router.replicas) == 3
+        standby = router.replicas[2]
+        assert standby.standby and not standby.retiring
+        s = router.metrics.summary()
+        assert s["scale_up"] == 1 and s["scale_down"] == 0
+        assert router.exposition()["act"]["fleet"] == 3
+        # burn persists: no second spawn (governor already entered)
+        router._sweep_actions()
+        assert len(router.replicas) == 3
+        assert router.metrics.summary()["scale_up"] == 1
+        # burn clears: governor exits, the standby retires
+        r0.hb_alerts = ()
+        router._sweep_actions()
+        assert standby.retiring
+        assert standby.state == EJECTED
+        s = router.metrics.summary()
+        assert s["scale_down"] == 1
+
+    def test_no_act_flag_disables_the_acting_half(self, tmp_path):
+        router = _mk_router(tmp_path, tmp_path / "unused.py", n=2)
+        router._act = False
+        r0, _ = router.replicas
+        r0.state = READY
+        r0.hb_alerts = ("ttft_p99",)
+        assert router._sweep_actions() == 0
+        assert not r0.steered
+        assert router.metrics.summary()["steers"] == 0

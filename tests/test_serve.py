@@ -1415,6 +1415,182 @@ class TestBrownout:
                    for r in shed_recs)
 
 
+class TestWorkloadIsolation:
+    """SLO-class isolation drill (PR 14): a batch flood submitted
+    AHEAD of interactive traffic must not win the TTFT race, overload
+    must shed batch only, and none of the class machinery may perturb
+    a single sampled token."""
+
+    def test_isolation_drill_batch_flood(self, llama):
+        from hyperion_tpu.serve.queue import (
+            CLASS_BATCH, CLASS_INTERACTIVE, REJECT_SHED)
+
+        model, variables = llama
+        eng = _engine(llama, slots=2, queue_capacity=16, brownout=True,
+                      brownout_depth=6, interactive_weight=3,
+                      batch_weight=1)
+        stats0 = eng.warmup([8, 16])
+        batch_keep = [
+            Request(prompt_ids=p, max_new_tokens=4, id=f"bk{i}",
+                    sla_class=CLASS_BATCH, tenant="adv_burst")
+            for i, p in enumerate(_prompts([6, 9, 5], seed=61))]
+        batch_doomed = [
+            Request(prompt_ids=p, max_new_tokens=4, id=f"bd{i}",
+                    sla_class=CLASS_BATCH, tenant="adv_burst",
+                    deadline_s=0.004)
+            for i, p in enumerate(_prompts([7, 8], seed=62))]
+        inter = [
+            Request(prompt_ids=p, max_new_tokens=3 + i, id=f"iq{i}")
+            for i, p in enumerate(_prompts([5, 8, 6, 9], seed=63))]
+        # the hostile ordering: the whole batch flood is queued before
+        # the first interactive request arrives
+        for r in batch_keep + batch_doomed + inter:
+            ok, reason = eng.submit(r)
+            assert ok, reason
+        time.sleep(0.01)  # doomed deadlines pass while queued
+        _drain(eng)
+
+        # sheds are batch-only; zero interactive requests were touched
+        s = eng.metrics.summary()
+        assert all(r.status == "rejected"
+                   and r.finish_reason == REJECT_SHED
+                   for r in batch_doomed)
+        assert s["by_class"][CLASS_BATCH]["shed"] == 2
+        assert s["by_class"][CLASS_INTERACTIVE]["shed"] == 0
+        assert s["by_class"][CLASS_INTERACTIVE]["completed"] == len(inter)
+
+        # weighted-fair admission won the TTFT race for interactive
+        # even though every batch prompt was queued first
+        ttft_i = s["by_class"][CLASS_INTERACTIVE]["ttft_ms"]["p99"]
+        ttft_b = s["by_class"][CLASS_BATCH]["ttft_ms"]["p99"]
+        assert ttft_i < ttft_b, (
+            f"interactive TTFT p99 {ttft_i} not under batch {ttft_b}")
+
+        # temp-0 bit-identity: class scheduling re-orders work, never
+        # tokens — survivors of BOTH classes match `generate`
+        for r in inter + batch_keep:
+            ref = np.asarray(generate(
+                model, variables, jnp.asarray(r.prompt_ids)[None],
+                r.max_new_tokens))[0].tolist()
+            assert r.tokens == ref, f"{r.id}: {r.tokens} != {ref}"
+        assert eng.compile_stats() == stats0, (
+            "class scheduling added an executable")
+
+    def test_class_brownout_order_clamps_batch_only(self, llama):
+        """The router's `class_brownout` control verb, exercised at the
+        engine API: while ordered, batch admissions get their budget
+        clamped as if the local governor were active; interactive is
+        untouched; lifting the order restores batch."""
+        from hyperion_tpu.serve.queue import (
+            CLASS_BATCH, CLASS_INTERACTIVE)
+
+        eng = _engine(llama, slots=2, queue_capacity=8,
+                      brownout_clamp=2)
+        eng.warmup([8])
+        res = eng.control({"cmd": "class_brownout", "active": True})
+        assert res["status"] == "ok" and res["changed"]
+        b = Request(prompt_ids=_prompts([6], seed=71)[0],
+                    max_new_tokens=8, id="cb_b", sla_class=CLASS_BATCH)
+        i = Request(prompt_ids=_prompts([6], seed=72)[0],
+                    max_new_tokens=8, id="cb_i")
+        for r in (b, i):
+            ok, reason = eng.submit(r)
+            assert ok, reason
+        res = eng.control({"cmd": "class_brownout", "active": False})
+        assert res["status"] == "ok" and res["changed"]
+        b2 = Request(prompt_ids=_prompts([6], seed=73)[0],
+                     max_new_tokens=8, id="cb_b2",
+                     sla_class=CLASS_BATCH)
+        ok, reason = eng.submit(b2)
+        assert ok, reason
+        _drain(eng)
+        assert b.clamped_from == 8 and len(b.tokens) == 2
+        assert i.clamped_from is None and len(i.tokens) == 8
+        assert b2.clamped_from is None and len(b2.tokens) == 8
+        s = eng.metrics.summary()
+        assert s["by_class"][CLASS_BATCH]["clamped"] == 1
+        assert s["by_class"][CLASS_INTERACTIVE]["clamped"] == 0
+
+
+class TestChunkedPrefill:
+    """Chunked prefill (PR 14): long prompts stream through the cache
+    in fixed chunks interleaved with decode. One static chunk shape is
+    exactly one executable, and chunking survives the full gauntlet —
+    prefix hits, preemption, and a mid-flight crash replay — with
+    every output still bit-identical to `generate`."""
+
+    def test_chunked_churn_preemption_replay_bit_identical(
+            self, tmp_path, llama):
+        from hyperion_tpu.serve.journal import RequestJournal
+
+        model, variables = llama
+        jp = tmp_path / "journal.jsonl"
+
+        def make(journal):
+            eng = _engine(llama, slots=3, block_size=8, num_blocks=8,
+                          admission="optimistic", queue_capacity=16,
+                          prefill_chunk=16)
+            eng.journal = journal
+            return eng
+
+        eng1 = make(RequestJournal(jp))
+        stats0 = eng1.warmup()
+        assert stats0["chunk_executables"] == 1, stats0
+        rng = np.random.default_rng(77)
+        shared = rng.integers(1, 250, 18).astype(np.int32)
+        s1: list = []
+        reqs = []
+        for i in range(12):
+            if i % 3 == 0:    # long + shared prefix: chunked, hits
+                ids = np.concatenate(
+                    [shared, rng.integers(1, 250, 4 + i % 7)])
+            elif i % 3 == 1:  # long, divergent: chunked, COW pressure
+                ids = rng.integers(1, 250, 17 + i % 9)
+            else:             # short growers: one-shot prefill path,
+                ids = rng.integers(1, 250, 5)  # preemption pressure
+            reqs.append(Request(prompt_ids=ids.astype(np.int32),
+                                max_new_tokens=5 + (i % 3) * 4,
+                                id=f"ch{i}", sink=s1.append))
+        for r in reqs:
+            ok, reason = eng1.submit(r)
+            assert ok, reason
+            eng1.step()
+        for _ in range(3):
+            eng1.step()  # crash mid-churn: chunked prefills in flight
+        crashed_mid = any(r.status != "done" for r in reqs)
+
+        eng2 = make(RequestJournal(jp))
+        assert eng2.warmup() == stats0
+        s2: list = []
+        info = eng2.replay_pending(s2.append)
+        assert crashed_mid and info["resumed"] > 0, (
+            "crash happened after everything finished")
+        _drain(eng2, max_steps=800)
+        eng2.journal.close_clean()
+
+        # union of both lives' client streams: every request's tokens
+        # exactly once, bit-identical to `generate`
+        per: dict[str, list[int]] = {}
+        for evs in (s1, s2):
+            for ev in evs:
+                if ev.kind == "token" and ev.token is not None:
+                    per.setdefault(ev.request.id, []).append(ev.token)
+        for r in reqs:
+            ref = np.asarray(generate(
+                model, variables, jnp.asarray(r.prompt_ids)[None],
+                r.max_new_tokens))[0].tolist()
+            assert per.get(r.id) == ref, (
+                f"{r.id}: {per.get(r.id)} != {ref}")
+
+        # the one-executable pin: the whole gauntlet — chunk segments,
+        # preemption recompute, replay — never compiled anything new
+        assert eng2.compile_stats() == stats0, (
+            "chunked churn recompiled the engine")
+        s = eng2.metrics.summary()
+        assert s["preempted"] > 0, "churn produced no preemption"
+        assert RequestJournal(jp).pending_count() == 0
+
+
 class TestFrontEndHardening:
     def test_malformed_line_is_a_counted_bad_request(self, tmp_path, llama):
         """Satellite: a malformed JSONL line produces a bad_request
