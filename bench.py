@@ -521,6 +521,9 @@ def _child_serving_scale() -> None:
         "affinity_hit_rate": endn.get("affinity_hit_rate"),
         "redispatched": endn.get("redispatched"),
         "ejections": endn.get("ejections"),
+        # exactly-once audit from the CLIENT side of the fleet run:
+        # stream-indexed duplicate deliveries (obs diff zero-pins it)
+        "duplicate_tokens": repn.get("duplicate_tokens", 0),
     }))
 
 

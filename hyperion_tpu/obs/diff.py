@@ -107,6 +107,10 @@ METRICS: dict[str, str] = {
     # Both gated so neither tier can quietly pay for the other.
     "serve_interactive_ttft_p99_ms": "lower",
     "serve_batch_shed_rate": "lower",
+    # exactly-once delivery (PR 15, the bench serving_scale row):
+    # stream-indexed duplicate deliveries the CLIENTS observed across
+    # the fleet run — zero-pinned, one duplicate is a dedup bug
+    "serve_duplicate_tokens": "lower",
 }
 
 # metrics whose healthy value is exactly zero: the percent-threshold
@@ -117,7 +121,10 @@ ZERO_PINNED = frozenset({"serve_recompiles",
                          # the class probe's healthy batch shed rate IS
                          # 0.0 — a zero-base skip would hide the exact
                          # regression this gate exists for
-                         "serve_batch_shed_rate"})
+                         "serve_batch_shed_rate",
+                         # exactly-once delivery: the ONLY healthy
+                         # duplicate count is 0
+                         "serve_duplicate_tokens"})
 
 
 def _num(v) -> float | None:
@@ -207,7 +214,9 @@ def normalize(doc: dict) -> dict[str, float]:
                               ("scaleup", "serve_scale_scaleup"),
                               ("fairness", "serve_scale_fairness"),
                               ("affinity_hit_rate",
-                               "serve_affinity_hit_rate")):
+                               "serve_affinity_hit_rate"),
+                              ("duplicate_tokens",
+                               "serve_duplicate_tokens")):
                 v = _num(scale.get(src))
                 if v is not None:
                     out[name] = v

@@ -608,6 +608,43 @@ def diagnose(
     if fleet_incidents:
         reason += "; fleet: " + "; ".join(fleet_incidents)
 
+    # Router WAL post-mortem (PR 15): a dead router LIFE leaves its
+    # dispatch WAL next to the stream — pending (dispatched, never
+    # terminal) entries are the streams it still owes clients, and the
+    # WAL tail is the crash's own evidence. Read-only: the next router
+    # life, not the doctor, performs the recovery.
+    router_wal: dict | None = None
+    wal_path = Path(tele_path).parent / "router_journal.jsonl"
+    if wal_path.exists():
+        try:
+            from hyperion_tpu.serve.router_journal import RouterJournal
+
+            wal = RouterJournal(wal_path)
+            router_wal = {"path": str(wal_path),
+                          "pending": wal.pending_count(),
+                          "tail": wal.tail(5)}
+        except Exception:  # noqa: BLE001 — a torn WAL must not kill
+            router_wal = None   # the diagnosis reading it
+    if router_wal and router_wal["pending"] > 0 \
+            and not any(e.get("name") == "router_end" for e in events):
+        tail_s = "; ".join(
+            f"{r.get('k')}"
+            + (f" {r.get('id')}" if r.get("id") else "")
+            + (f" i={r.get('i')}" if r.get("k") == "hwm" else "")
+            + (f" replica={r.get('replica')}"
+               if r.get("k") == "dispatch" else "")
+            for r in router_wal["tail"])
+        incident = (
+            f"router died owing {router_wal['pending']} in-flight "
+            f"stream(s) — the dispatch WAL ({wal_path.name}) holds "
+            f"their placements and high-water marks (tail: {tail_s}); "
+            "a supervised restart re-adopts live replicas and resumes "
+            "them exactly-once")
+        router_wal["incident"] = incident
+        if verdict in ("healthy", "running", "stalled", "failed",
+                       "crashed", "hung"):
+            reason += "; router WAL: " + incident
+
     # Hostile-tenant attribution (PR 14): adversarial workload profiles
     # tag their requests with a tenant label, and the engine's
     # admit/shed events carry it through — so when a run degraded, the
@@ -853,6 +890,8 @@ def diagnose(
         "slo_incidents": slo_incidents,
         "fleet": fleet_rows,
         "fleet_incidents": fleet_incidents,
+        # router crash safety (PR 15): the dispatch WAL's post-mortem
+        "router_wal": router_wal,
         # workload-isolation plane (PR 14): who drove the pressure and
         # what the acting router did about it
         "tenants": tenants,
@@ -1067,6 +1106,13 @@ def render_markdown(d: dict) -> str:
             f"rejected {row['rejected']}{flag} |")
     for act in d.get("router_actions") or []:
         lines.append(f"| router action | {act} |")
+    wal = d.get("router_wal")
+    if wal:
+        lines.append(
+            f"| router WAL | {wal['pending']} pending dispatch(es) in "
+            f"`{Path(wal['path']).name}`"
+            + (" — **owed streams**" if wal.get("incident") else "")
+            + " |")
     for row in d.get("tail_attribution") or []:
         comps = ", ".join(f"{p} {v:.1f}"
                           for p, v in row["components_ms"].items() if v)

@@ -29,6 +29,7 @@ degrade the observability plane, never the process it observes.
 
 from __future__ import annotations
 
+import fcntl
 import json
 import os
 import socket as socket_mod
@@ -53,7 +54,7 @@ def exposition_path(anchor: str | Path) -> Path:
 
 
 def prepare_socket_path(socket_path: str,
-                        owner: str = "live process") -> None:
+                        owner: str = "live process", bind=None):
     """Make `socket_path` bindable: a socket file that survived a
     crash (SIGKILL unlinks nothing) would fail the bind forever. Probe
     it first — a connection REFUSED means no listener owns it (stale:
@@ -62,24 +63,62 @@ def prepare_socket_path(socket_path: str,
     THE one implementation of this discipline: the serve transports
     (serve/server.py) delegate here, obs is jax-free, so both layers
     share it without serve's import chain. `owner` names the refuser
-    in the error ("live server" for transports)."""
-    if not os.path.exists(socket_path):
-        return
-    probe = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
-    probe.settimeout(0.25)
+    in the error ("live server" for transports).
+
+    The probe-unlink-bind window is racy on its own: two supervised
+    children restarting at once can each probe the OTHER's socket in
+    the instant between its bind and its first accept, read the
+    refusal as stale, and unlink a fresh socket out from under its
+    owner. So the whole window runs under an exclusive flock on a
+    `.lock` sibling, and callers that bind pass the bind as a callback
+    (`bind() -> bound server`) so it happens INSIDE the lock; the
+    lock file itself is never unlinked (unlinking would let a third
+    process lock a fresh inode while the second still holds the old
+    one, resurrecting the race). Lock failures degrade to the old
+    unlocked behavior — this is crash-hygiene, not correctness of the
+    socket itself. Returns whatever `bind` returns (None without)."""
+    lock_fd = None
     try:
-        probe.connect(socket_path)
+        lock_fd = os.open(socket_path + ".lock",
+                          os.O_CREAT | os.O_RDWR, 0o644)
+        fcntl.flock(lock_fd, fcntl.LOCK_EX)
     except OSError:
-        try:
-            os.unlink(socket_path)
-        except OSError:
-            pass
-    else:
-        raise RuntimeError(
-            f"socket {socket_path} is owned by a {owner} — refusing "
-            "to steal it (stop the other process or pick another path)")
+        if lock_fd is not None:
+            try:
+                os.close(lock_fd)
+            except OSError:
+                pass
+        lock_fd = None
+    try:
+        if os.path.exists(socket_path):
+            probe = socket_mod.socket(socket_mod.AF_UNIX,
+                                      socket_mod.SOCK_STREAM)
+            probe.settimeout(0.25)
+            try:
+                probe.connect(socket_path)
+            except OSError:
+                try:
+                    os.unlink(socket_path)
+                except OSError:
+                    pass
+            else:
+                raise RuntimeError(
+                    f"socket {socket_path} is owned by a {owner} — "
+                    "refusing to steal it (stop the other process or "
+                    "pick another path)")
+            finally:
+                probe.close()
+        return bind() if bind is not None else None
     finally:
-        probe.close()
+        if lock_fd is not None:
+            try:
+                fcntl.flock(lock_fd, fcntl.LOCK_UN)
+            except OSError:
+                pass
+            try:
+                os.close(lock_fd)
+            except OSError:
+                pass
 
 
 class MetricsExporter:
@@ -171,8 +210,11 @@ class MetricsExporter:
         try:
             Path(self.socket_path).parent.mkdir(parents=True,
                                                 exist_ok=True)
-            prepare_socket_path(self.socket_path)
-            self._srv = Server(self.socket_path, Handler)
+            # bind inside the prepare lock: a sibling restarting at the
+            # same instant must not probe-and-unlink this fresh socket
+            self._srv = prepare_socket_path(
+                self.socket_path,
+                bind=lambda: Server(self.socket_path, Handler))
             self._bound = True
         except Exception as e:  # noqa: BLE001 — never kill the host loop
             print(f"[{self._label}] exposition disabled "

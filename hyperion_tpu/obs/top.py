@@ -139,6 +139,12 @@ def _act_cell(act: dict) -> str | None:
         bits.append("steer:" + ",".join(str(i) for i in steered))
     if act.get("max_replicas"):
         bits.append(f"fleet:{act.get('fleet')}/{act['max_replicas']}")
+    # router crash safety (PR 15): replicas this life adopted from a
+    # dead predecessor, and client streams resumed across the cut
+    if act.get("adopted"):
+        bits.append(f"adopt:{act['adopted']}")
+    if act.get("resumes"):
+        bits.append(f"res:{act['resumes']}")
     return "+".join(bits) or "-"
 
 

@@ -156,6 +156,71 @@ class RequestJournal:
                 pass
             self._f = None
 
+    # ------------------------------------------------------------ compact
+
+    def _compact(self, live_ids: set[str], clean: bool = False) -> bool:
+        """Rewrite the journal keeping only the current life's records
+        of `live_ids`, byte-exactly, when more than half the records on
+        file are dead weight (terminal requests, settled pre-close
+        history, torn lines). Atomic: the kept lines land in a sibling
+        temp file that `os.replace`s the journal, so a crash mid-compact
+        leaves either the old file or the new one, never a torn hybrid.
+        Called at recovery — supervised restarts otherwise grow the WAL
+        forever with requests nobody will ever replay again."""
+        try:
+            with self._lock:
+                if self._f is not None:
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+                    self._f.close()
+                    self._f = None
+                try:
+                    lines = self.path.read_text(
+                        encoding="utf-8").splitlines(keepends=True)
+                except OSError:
+                    return False
+                keep: list[str] = []
+                total = 0
+                for line in lines:
+                    s = line.strip()
+                    if not s:
+                        continue
+                    total += 1
+                    try:
+                        rec = json.loads(s)
+                    except json.JSONDecodeError:
+                        continue  # torn line: never worth carrying over
+                    if not isinstance(rec, dict):
+                        continue
+                    if rec.get("k") == "close":
+                        # settled history: everything before a close is
+                        # done with — a compact starts the file at the
+                        # current life
+                        keep.clear()
+                        continue
+                    if rec.get("id") in live_ids:
+                        keep.append(line if line.endswith("\n")
+                                    else line + "\n")
+                if clean and not keep:
+                    # a clean-closed file compacts to just the close
+                    # marker: "nothing owed because cleanly shut down"
+                    # must stay distinguishable from "no journal at all"
+                    keep = ['{"k":"close"}\n']
+                if total == 0 or (total - len(keep)) * 2 <= total:
+                    return False
+                tmp = self.path.with_name(self.path.name + ".compact")
+                with tmp.open("w", encoding="utf-8") as f:
+                    f.writelines(keep)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+                return True
+        except OSError as e:
+            # compaction is an optimization: failing it degrades to the
+            # old ever-growing file, never to a lost journal
+            self.error = str(e)
+            return False
+
     # ------------------------------------------------------------- read
 
     def _parse(self) -> tuple[dict, list[str], bool]:
@@ -275,6 +340,10 @@ class RequestJournal:
                 self._append({"k": "replay", "id": rid,
                               "n": req.replays}, sync=True)
                 resume.append(req)
+        # compact AFTER the recovery marks: terminal requests (including
+        # the finish/poisoned records just appended) drop out; the
+        # resumed requests' admit/tok/replay history survives byte-exact
+        self._compact({req.id for req in resume}, clean=clean)
         return resume, finished, poisoned, clean
 
     def pending_count(self) -> int:

@@ -399,6 +399,7 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
         res = results[i]
         sent = time.monotonic()
         res["submitted_at"] = sent
+        expected = 0  # next stream index owed — dup/gap audit
         try:
             with ServeClient(socket_path,
                              timeout_s=request_timeout_s) as c:
@@ -409,6 +410,17 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
                     if ev == "token" and rec.get("token") is not None:
                         res.setdefault("first_token_at", time.monotonic())
                         res["tokens"] = res.get("tokens", 0) + 1
+                        # exactly-once audit off the wire's stream
+                        # index: an index below the expected one is a
+                        # DUPLICATE delivery (a failover/resume dedup
+                        # bug) — `obs diff` zero-pins the total
+                        si = rec.get("i")
+                        if isinstance(si, int):
+                            if si < expected:
+                                res["dup_tokens"] = \
+                                    res.get("dup_tokens", 0) + 1
+                            else:
+                                expected = si + 1
                     elif ev in ("done", "rejected", "timed_out",
                                 "error"):
                         res["status"] = ev
@@ -458,6 +470,9 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
         "reject_rate": round(rejected / spec.n_requests, 4)
         if spec.n_requests else 0.0,
         "tokens": tokens,
+        # exactly-once delivery audit: stream-indexed duplicates seen
+        # across ALL requests (zero unless failover/resume dedup broke)
+        "duplicate_tokens": sum(r.get("dup_tokens", 0) for r in results),
         "tokens_per_s": round(tokens / elapsed, 2) if elapsed > 0 else 0.0,
         "ttft_p50_ms": round(percentile(ttft_ms, 50), 3) if ttft_ms else None,
         "ttft_p99_ms": round(percentile(ttft_ms, 99), 3) if ttft_ms else None,

@@ -381,7 +381,13 @@ class RouterMetrics:
                      "router_steers", "router_unsteers",
                      "router_scale_up", "router_scale_down",
                      "class_brownouts_ordered",
-                     "class_brownouts_lifted"):
+                     "class_brownouts_lifted",
+                     # router crash safety: client streams resumed
+                     # across a disconnect, WAL orphans recovered by a
+                     # new router life, replicas adopted (taken over
+                     # live, no respawn) from a previous life
+                     "route_resumes", "route_orphans_recovered",
+                     "route_adopted"):
             self.reg.counter(name)
         self.reg.gauge("fleet_ready").set(0.0)
         self.reg.gauge("fleet_inflight").set(0.0)
@@ -454,6 +460,25 @@ class RouterMetrics:
         with self._lock:
             self.reg.gauge("fleet_steered").set(n)
 
+    def on_resume(self) -> None:
+        """One client resume verb answered (reconnect after a wire cut
+        or a router death)."""
+        with self._lock:
+            self.reg.counter("route_resumes").inc()
+
+    def on_orphans(self, n: int) -> None:
+        """`n` orphaned dispatches recovered from a previous router
+        life's WAL."""
+        if n:
+            with self._lock:
+                self.reg.counter("route_orphans_recovered").inc(n)
+
+    def on_adopt(self) -> None:
+        """One still-live replica adopted from a previous router life
+        (taken over from its heartbeat, not respawned)."""
+        with self._lock:
+            self.reg.counter("route_adopted").inc()
+
     def on_fleet_alerts(self, n_new: int) -> None:
         """`n_new` alert names appeared on replica heartbeats since the
         last monitor sweep (serve/router.py counts the transitions —
@@ -495,4 +520,8 @@ class RouterMetrics:
             "scale_down": int(c.get("router_scale_down", 0)),
             "class_brownouts": int(c.get("class_brownouts_ordered", 0)),
             "steered_now": int(g.get("fleet_steered") or 0),
+            # router crash safety (rides router_end for bench/doctor)
+            "resumes": int(c.get("route_resumes", 0)),
+            "orphans_recovered": int(c.get("route_orphans_recovered", 0)),
+            "adopted": int(c.get("route_adopted", 0)),
         }

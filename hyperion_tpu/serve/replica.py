@@ -89,6 +89,11 @@ class ReplicaHandle:
     # fleet); retiring standbys exit instead of restarting on next exit
     standby: bool = False
     retiring: bool = False
+    # adopted: a restarted router found this replica's previous-life
+    # child still alive and serving (fresh serve-phase heartbeat, pid
+    # answers) and took it over WITHOUT a respawn — there is no Popen
+    # handle for it, so shutdown signals it by heartbeat pid instead
+    adopted: bool = False
 
     @classmethod
     def under(cls, base_dir: str | Path, index: int) -> "ReplicaHandle":

@@ -55,12 +55,27 @@ process that turns "a server" into "a deployment" (ROADMAP item 3):
     quiet again. Every action is a telemetry event (`router_steer`,
     `router_scale`, `class_brownout`) that `obs doctor` narrates.
 
+  * **The router itself is no longer the SPOF** — a router WAL
+    (`serve/router_journal.py`) journals every dispatch (original wire
+    line, chosen replica, session key) and each stream's forwarded
+    high-water mark, flushed ahead of the client write like the
+    replica journals. Under `hyperion route --supervise` the router
+    runs with its own heartbeat watchdog; a restarted router life
+    RE-ADOPTS still-live replicas straight from their heartbeats
+    (no respawn, no replay storm), recovers the WAL, and re-dispatches
+    orphaned streams through the same dedup + seed-deterministic
+    recompute path — the union stream across router lives stays
+    bit-identical and duplicate-free. Clients ride it out with the
+    wire protocol's `resume` verb (`serve/client.py` auto-reconnects
+    and resumes from its own last received index).
+
 Failure matrix (SERVING.md "Replica tier" has the long version):
 replica crash → supervised restart + journal replay + router failover;
-router crash → replicas are orphaned children and the client stream is
-lost, but every replica journal is intact — a new router re-spawns
-them and each replays its owed work to completion; both crash →
-restart the router: same as router crash.
+router crash → the supervisor restarts it, the new life re-adopts the
+still-live replicas and recovers the dispatch WAL, and auto-resuming
+clients reconnect and receive the rest of each stream exactly once;
+both crash → replicas replay their journals first, the router
+re-adopts (or respawns the dead), clients resume last.
 """
 
 from __future__ import annotations
@@ -69,6 +84,8 @@ import argparse
 import hashlib
 import itertools
 import json
+import os
+import signal
 import subprocess
 import sys
 import threading
@@ -89,8 +106,9 @@ from hyperion_tpu.serve.queue import (
     REJECT_QUEUE_FULL,
     BrownoutGovernor,
 )
-from hyperion_tpu.serve.replica import READY, ReplicaHandle
-from hyperion_tpu.serve.server import _LineWriter
+from hyperion_tpu.serve.replica import SERVE_PHASES, READY, ReplicaHandle
+from hyperion_tpu.serve.router_journal import OrphanedDispatch, RouterJournal
+from hyperion_tpu.serve.server import _LineWriter, maybe_resume_doc
 from hyperion_tpu.utils.retry import RetryPolicy
 
 # connect policy for replica dispatch: generous enough to ride a
@@ -396,6 +414,31 @@ class Router:
         self._rids = itertools.count()
         self._stopping = threading.Event()   # no new work
         self._hard_stop = threading.Event()  # abandon in-flight relays
+        # router-scoped chaos (crash@dispatch, conn_reset): its state
+        # file sits next to the WAL so dispatch-count faults fire once
+        # per supervisor LINEAGE, not once per router life
+        self.chaos = None
+        if getattr(args, "chaos", ""):
+            from hyperion_tpu.testing import chaos as chaos_mod
+
+            self.chaos = chaos_mod.activate(
+                args.chaos, state_path=base / "route_chaos_state.json")
+        # the router WAL (serve/router_journal.py): dispatch records +
+        # forwarded high-water marks, recovered by the next router life
+        jpath = str(getattr(args, "router_journal", "") or "")
+        self.journal: RouterJournal | None = None
+        if jpath not in ("off", "none", "0"):
+            self.journal = RouterJournal(
+                jpath or str(base / "router_journal.jsonl"),
+                fault=(self.chaos.journal_io
+                       if self.chaos is not None else None))
+        self._dispatch_n = itertools.count(1)  # chaos crash@dispatch
+        # resume bookkeeping: original wire lines by request id (bounded
+        # — a resume for an evicted id falls back to the WAL or the
+        # client's carried request), plus WAL orphans awaiting a
+        # socket-mode client's resume verb
+        self._resume_docs: OrderedDict[str, str] = OrderedDict()
+        self._recovered: dict[str, OrphanedDispatch] = {}
         self._mon_stop = threading.Event()
         self._mon_thread: threading.Thread | None = None
         # live plane: alert names already seen per replica (so the
@@ -449,12 +492,82 @@ class Router:
         if self.policy.eject(rep, reason):
             self._notify_eject(rep, reason)
 
+    def _adopt_live(self, rep: ReplicaHandle) -> int | None:
+        """A previous router life's child may still be alive and
+        serving — restarting it would throw away its warm caches and
+        force a pointless journal replay. Adoption test: a fresh
+        serve-phase heartbeat whose pid answers signal 0. Returns the
+        live pid, or None (spawn normally)."""
+        from hyperion_tpu.obs.heartbeat import read_heartbeat
+
+        hb = read_heartbeat(rep.heartbeat_path)
+        if not isinstance(hb, dict):
+            return None
+        t_wall = hb.get("t_wall")
+        pid = hb.get("pid")
+        if hb.get("phase") not in SERVE_PHASES \
+                or not isinstance(t_wall, (int, float)) \
+                or time.time() - float(t_wall) > self.args.stale_s \
+                or not isinstance(pid, int) or pid <= 0:
+            return None
+        try:
+            os.kill(pid, 0)
+        except (OSError, ProcessLookupError):
+            return None
+        return pid
+
+    def _babysit_adopted(self, rep: ReplicaHandle, pid: int) -> bool:
+        """Watch an adopted child until it dies or we stop. True means
+        the router is stopping/retiring it (supervisor thread should
+        end); False means the child died — fall through to a normal
+        supervised respawn."""
+        hang = self.args.hang_timeout
+        while True:
+            if self._stopping.is_set() or rep.retiring:
+                return True
+            try:
+                os.kill(pid, 0)
+            except (OSError, ProcessLookupError):
+                return False
+            if hang > 0 and rep.hb_t_wall is not None \
+                    and time.time() - rep.hb_t_wall > hang:
+                # wedged exactly like a spawned child would be: the
+                # watchdog contract applies to adoptees too
+                self._log(f"[route] adopted replica {rep.index} "
+                          f"heartbeat stale past {hang:.0f}s — SIGKILL "
+                          f"pid {pid}")
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    pass
+                return False
+            time.sleep(0.25)
+
     def _supervise_one(self, rep: ReplicaHandle) -> None:
         from hyperion_tpu.supervisor import (
             Decision,
             heartbeat_watchdog,
             supervise_loop,
         )
+
+        pid = self._adopt_live(rep)
+        if pid is not None:
+            rep.adopted = True
+            self.metrics.on_adopt()
+            self.tracer.event("replica_adopted", replica=rep.index,
+                              pid=pid)
+            self._log(f"[route] replica {rep.index} adopted from a "
+                      f"previous router life (pid {pid}) — serving "
+                      "continues uninterrupted")
+            if self._babysit_adopted(rep, pid):
+                return
+            rep.adopted = False
+            self._eject(rep, "adopted replica died")
+            self.tracer.event("replica_exit", replica=rep.index,
+                              rc=None, adopted=True)
+            if self._stopping.is_set() or rep.retiring:
+                return
+            rep.restarts += 1  # the respawn below is a restart
 
         try:
             err_fd = sys.stderr.fileno()
@@ -515,6 +628,7 @@ class Router:
             "alerts": list(r.hb_alerts),
             "steered": r.steered, "standby": r.standby,
         } for r in self.replicas]
+        msum = self.metrics.summary()
         own = (self._slo.active_names() if self._slo is not None else [])
         # the aggregated list counts READY replicas only (a dead
         # child's stale alarm is not a live alert); the per-replica
@@ -526,7 +640,7 @@ class Router:
             "role": "router",
             "run": self.tracer.run,
             "phase": "route",
-            "step": self.metrics.summary()["dispatched"],
+            "step": msum["dispatched"],
             "active": self.policy.inflight_total,
             "queue": 0,
             "ready": self.policy.ready_count,
@@ -540,6 +654,10 @@ class Router:
                 "steered": [r.index for r in self.replicas if r.steered],
                 "fleet": len(self.replicas),
                 "max_replicas": self._max_replicas,
+                # crash-safety counters: replicas adopted from a dead
+                # router life, client streams resumed across the cut
+                "adopted": msum["adopted"],
+                "resumes": msum["resumes"],
             },
             "metrics": self.metrics.reg.snapshot(),
             "windows": self.metrics.reg.windowed_snapshot(window_s),
@@ -806,7 +924,11 @@ class Router:
     def submit_line(self, line: str, writer) -> threading.Thread | None:
         """Parse the routing envelope of one wire line and hand it to a
         relay thread. Malformed lines reject immediately with the
-        standard vocabulary — never an exception on the intake path."""
+        standard vocabulary — never an exception on the intake path.
+        The wire protocol's `resume` verb takes the resume path
+        instead of a fresh dispatch."""
+        if (rdoc := maybe_resume_doc(line)) is not None:
+            return self._resume(rdoc, writer)
         try:
             doc = json.loads(line)
             if not isinstance(doc, dict):
@@ -826,9 +948,19 @@ class Router:
         if self._stopping.is_set():
             self._reject(rid, REJECT_DRAINING, time.monotonic(), writer)
             return None
+        # the WAL line: the request exactly as the client sent it (plus
+        # the minted id) — what a NEXT router life needs to re-dispatch.
+        # Remembered in-process too, so a client resume after conn_reset
+        # does not depend on the client carrying its request back.
+        wal_line = json.dumps(doc, separators=(",", ":"))
+        self._resume_docs[rid] = wal_line
+        while len(self._resume_docs) > 1024:
+            self._resume_docs.popitem(last=False)
         with self._req_lock:
             self._active.add(rid)
-        t = threading.Thread(target=self._relay, args=(rid, doc, writer),
+        t = threading.Thread(target=self._relay,
+                             args=(rid, doc, writer),
+                             kwargs={"wal_line": wal_line},
                              name=f"relay-{rid}", daemon=True)
         t.start()
         if len(self._req_threads) > 256:
@@ -845,17 +977,29 @@ class Router:
         self.tracer.event(
             "request_rejected", request=rid, reason=reason,
             queued_s=round(max(0.0, time.monotonic() - submitted), 6))
+        if self.journal is not None:
+            self.journal.done(rid, reason)
         writer.write({"id": rid, "event": "rejected", "reason": reason})
 
     # ---------------------------------------------------------- relay
 
-    def _relay(self, rid: str, doc: dict, writer) -> None:
+    def _relay(self, rid: str, doc: dict, writer, *,
+               resume_from: int = 0, wal_line: str | None = None,
+               as_resume: bool = False) -> None:
         try:
-            self._relay_inner(rid, doc, _ClientWriter(writer))
+            self._relay_inner(rid, doc, _ClientWriter(writer),
+                              resume_from=resume_from, wal_line=wal_line,
+                              as_resume=as_resume)
         except ClientGone as e:
             # the CLIENT vanished mid-stream: its request dies with it
             # (nothing left to deliver to), the replica keeps serving —
-            # the engine's own dropped-sink handling finishes the slot
+            # the engine's own dropped-sink handling finishes the slot.
+            # Terminal in the WAL too: a RESUME re-opens it (the parse
+            # side treats dispatch-after-done as exactly that), but a
+            # router death must not re-dispatch a stream whose client
+            # already walked away.
+            if self.journal is not None:
+                self.journal.done(rid, "client_gone")
             self.tracer.event("client_disconnected", request=rid,
                               error=str(e)[:200])
         except Exception as e:  # noqa: BLE001 — a relay bug must reject
@@ -870,9 +1014,15 @@ class Router:
             with self._req_lock:
                 self._active.discard(rid)
 
-    def _relay_inner(self, rid: str, doc: dict, writer) -> None:
+    def _relay_inner(self, rid: str, doc: dict, writer, *,
+                     resume_from: int = 0, wal_line: str | None = None,
+                     as_resume: bool = False) -> None:
         submitted = time.monotonic()
         dedup = StreamDedup()
+        # a resume (client-driven or WAL orphan re-dispatch) floors the
+        # dedup at what was already forwarded — the replica recomputes
+        # the identical stream from 0 and only the remainder passes
+        dedup.delivered = max(0, int(resume_from))
         crashed: set[int] = set()   # replicas this request already
         #                             visited: their journals hold its
         #                             admit record — never go back
@@ -906,9 +1056,24 @@ class Router:
             self.tracer.event(
                 "route_dispatch", request=rid, replica=rep.index,
                 affinity=meta["affinity_hit"], redispatch=redispatches)
+            # WAL before wire: the placement is durable before the
+            # replica can possibly have seen the request
+            if self.journal is not None:
+                self.journal.dispatch(
+                    rid,
+                    line=(wal_line if wal_line is not None
+                          else json.dumps(doc, separators=(",", ":"))),
+                    replica=rep.index,
+                    session=self.policy.affinity_key(doc),
+                    n=redispatches)
+            if self.chaos is not None:
+                # counts every placement router-wide — the
+                # crash@dispatch=N drill's trigger
+                self.chaos.on_dispatch(next(self._dispatch_n))
             try:
-                outcome, terminal = self._stream_from(rep, doc, dedup,
-                                                      writer)
+                outcome, terminal = self._stream_from(rep, rid, doc,
+                                                      dedup, writer,
+                                                      as_resume=as_resume)
             except (OSError, ConnectionError, ValueError) as e:
                 # mid-stream death (or connect that never came up):
                 # eject, fail over. The renewed deadline is deliberate —
@@ -941,6 +1106,8 @@ class Router:
                                   reason=REJECT_QUEUE_FULL)
                 continue
             self.metrics.on_complete()
+            if self.journal is not None:
+                self.journal.done(rid, outcome)
             self.tracer.event(
                 "route_complete", request=rid, replica=rep.index,
                 status=outcome, tokens=dedup.delivered,
@@ -948,21 +1115,40 @@ class Router:
                 e2e_s=round(time.monotonic() - submitted, 6))
             return
 
-    def _stream_from(self, rep: ReplicaHandle, doc: dict,
-                     dedup: StreamDedup, writer) -> tuple[str, dict]:
+    def _stream_from(self, rep: ReplicaHandle, rid: str, doc: dict,
+                     dedup: StreamDedup, writer,
+                     as_resume: bool = False) -> tuple[str, dict]:
         """One dispatch attempt: open the replica stream, forward
         deduplicated records to the client. Returns (outcome, terminal
         record) where outcome is the terminal event name or
         "queue_full" (the one rejection the router retries elsewhere
         instead of forwarding). Raises OSError/ConnectionError on a
-        dead replica — the caller's failover path."""
+        dead replica — the caller's failover path.
+
+        `as_resume` relays the request as the wire protocol's resume
+        verb instead of the raw request: the replica suffixes its
+        internal wire id, so a replica that already holds this id's
+        admit record (it served the stream before the crash) never
+        sees a duplicate id on its journal."""
         with ServeClient(rep.socket_path,
                          timeout_s=self.args.stream_timeout,
                          retry=DISPATCH_CONNECT_RETRY) as client:
-            for rec in client.stream(**doc):
+            if as_resume:
+                stream = client.stream(
+                    kind="resume", request_id=rid,
+                    next_index=dedup.delivered, request=doc, id=rid)
+            else:
+                stream = client.stream(**doc)
+            for rec in stream:
                 ev = rec.get("event")
                 if ev == "token":
                     if dedup.admit(rec):
+                        # hwm ahead of the client write (mirror of the
+                        # replica journal's journal-before-sink rule):
+                        # a router death between the two costs AT MOST
+                        # one replayed-and-deduped token on recovery
+                        if self.journal is not None:
+                            self.journal.hwm(rid, dedup.delivered)
                         writer.write(rec)
                     continue
                 if ev in TERMINAL_EVENTS:
@@ -975,6 +1161,95 @@ class Router:
                 writer.write(rec)
         raise ConnectionError("replica stream ended without a terminal "
                               "event")
+
+    # --------------------------------------------------------- resume
+
+    def _resume(self, doc: dict, writer) -> threading.Thread | None:
+        """Answer a client's `resume {request_id, next_index}` verb:
+        find the original request (in-process memory from this life,
+        the WAL orphan a previous life left, or the copy the client
+        itself carried — in that order) and relay it again with the
+        dedup floored at the client's own index. The client's count is
+        authoritative: the journaled hwm may run one token ahead."""
+        rid = str(doc.get("request_id") or "")
+        try:
+            next_index = max(0, int(doc.get("next_index", 0)))
+        except (TypeError, ValueError):
+            next_index = 0
+        src: dict | None = None
+        wal_line = self._resume_docs.get(rid) if rid else None
+        if wal_line is not None:
+            try:
+                src = json.loads(wal_line)
+            except json.JSONDecodeError:
+                src = None
+        if src is None and rid in self._recovered:
+            orphan = self._recovered.pop(rid)
+            src = orphan.doc
+            wal_line = orphan.line if src is not None else None
+        if src is None:
+            carried = doc.get("request")
+            if isinstance(carried, dict):
+                src = dict(carried)
+                src["id"] = rid
+                wal_line = json.dumps(src, separators=(",", ":"))
+        if not rid or not isinstance(src, dict):
+            writer.write({"id": rid or None, "event": "rejected",
+                          "reason": "unknown_request"})
+            return None
+        self.metrics.on_resume()
+        self.tracer.event("route_resume", request=rid,
+                          next_index=next_index)
+        self._log(f"[route] resuming {rid} from index {next_index}")
+        with self._req_lock:
+            self._active.add(rid)
+        t = threading.Thread(
+            target=self._relay, args=(rid, src, writer),
+            kwargs={"resume_from": next_index, "wal_line": wal_line,
+                    "as_resume": True},
+            name=f"resume-{rid}", daemon=True)
+        t.start()
+        self._req_threads.append(t)
+        return t
+
+    def recover_journal(self, writer=None) -> int:
+        """Recover the previous router life's WAL. Socket mode
+        (writer=None): orphans wait for their clients' resume verbs —
+        the client's own index is the authoritative floor, and a
+        pre-emptive re-dispatch would race the reconnect. JSONL mode:
+        there is no reconnect (the pipe is the client), so orphans
+        re-dispatch immediately, floored at the journaled hwm."""
+        if self.journal is None:
+            return 0
+        orphans, clean = self.journal.recover()
+        if not orphans:
+            return 0
+        self.metrics.on_orphans(len(orphans))
+        for o in orphans:
+            self.tracer.event("route_orphan_recovered", request=o.id,
+                              replica=o.replica, hwm=o.hwm,
+                              dispatches=o.dispatches)
+        self._log(f"[route] WAL recovery: {len(orphans)} orphaned "
+                  f"dispatch(es) from a previous router life")
+        if writer is None:
+            self._recovered = {o.id: o for o in orphans}
+            return len(orphans)
+        for o in orphans:
+            src = o.doc
+            if src is None:
+                self.journal.done(o.id, "unrecoverable")
+                continue
+            self._resume_docs[o.id] = o.line
+            with self._req_lock:
+                self._active.add(o.id)
+            t = threading.Thread(
+                target=self._relay, args=(o.id, src, writer),
+                kwargs={"resume_from": o.hwm, "wal_line": o.line,
+                        "as_resume": True},
+                name=f"recover-{o.id}", daemon=True)
+            t.start()
+            self._req_threads.append(t)
+        return len(orphans)
 
     # ------------------------------------------------------- shutdown
 
@@ -991,6 +1266,14 @@ class Router:
                     try:
                         proc.kill() if kill else proc.terminate()
                     except OSError:
+                        pass
+                elif rep.adopted and rep.hb_pid:
+                    # adopted from a previous router life: no Popen
+                    # handle, signal by the heartbeat's pid
+                    try:
+                        os.kill(rep.hb_pid, signal.SIGKILL if kill
+                                else signal.SIGTERM)
+                    except (OSError, ProcessLookupError):
                         pass
 
         # a child may still be mid-spawn: wait briefly for every live
@@ -1015,6 +1298,13 @@ class Router:
             self._mon_thread.join(timeout=5.0)
         if self._exporter is not None:
             self._exporter.close()
+        if self.journal is not None:
+            # clean only when nothing is owed: an in-flight stream at
+            # hard-stop must survive as a WAL orphan for the next life
+            if self.requests_idle:
+                self.journal.close_clean()
+            else:
+                self.journal.close()
         summary = self.metrics.summary()
         summary["per_replica_restarts"] = {
             str(r.index): r.restarts for r in self.replicas}
@@ -1038,6 +1328,10 @@ def route_jsonl(router: Router, infile, outfile,
     router drains on EOF (same composition contract as serve_jsonl —
     the smoke script pipes into it)."""
     out = _LineWriter(outfile)
+    # a previous router life's orphans re-dispatch straight onto this
+    # pipe — there is no per-client reconnect in JSONL mode, the hwm
+    # floor is the only dedup boundary
+    router.recover_journal(out)
     eof = threading.Event()
 
     def reader():
@@ -1070,13 +1364,39 @@ def route_socket(router: Router, socket_path: str,
     """Unix-socket mode: each connection's requests relay back over its
     own writer — the same transport contract as serve_socket, one
     level up."""
+    import socket as socket_mod
     import socketserver
 
     from hyperion_tpu.serve.server import prepare_socket_path
 
+    class _ChaosResetWriter:
+        """conn_reset@p=X injection point: before each client write the
+        chaos plan may raise ConnectionResetError; the handler then
+        hard-closes the connection so the CLIENT sees the cut (EOF
+        mid-stream) and exercises its resume path."""
+
+        def __init__(self, writer, connection):
+            self._w = writer
+            self._conn = connection
+
+        def write(self, rec) -> None:
+            try:
+                router.chaos.conn_reset("route_client_write")
+            except ConnectionResetError:
+                try:
+                    self._conn.shutdown(socket_mod.SHUT_RDWR)
+                    self._conn.close()
+                except OSError:
+                    pass
+                raise
+            self._w.write(rec)
+
     class Handler(socketserver.StreamRequestHandler):
         def handle(self):
             writer = _LineWriter(self.wfile)
+            if router.chaos is not None and any(
+                    f.kind == "conn_reset" for f in router.chaos.faults):
+                writer = _ChaosResetWriter(writer, self.connection)
             mine: list[threading.Thread] = []
             for raw in self.rfile:
                 try:
@@ -1100,8 +1420,14 @@ def route_socket(router: Router, socket_path: str,
             router.tracer.event("client_error",
                                 client=str(client_address))
 
-    prepare_socket_path(socket_path)
-    srv = Server(socket_path, Handler)
+    # orphans from a previous life park in _recovered and wait for
+    # their clients' resume verbs — BEFORE the socket opens, so a fast
+    # reconnect cannot race the recovery scan
+    router.recover_journal(None)
+    # bind under the flock so a dying previous life's still-bound file
+    # can never be probed/unlinked/rebound into a race
+    srv = prepare_socket_path(socket_path,
+                              bind=lambda: Server(socket_path, Handler))
     acceptor = threading.Thread(target=srv.serve_forever,
                                 name="route-accept", daemon=True)
     acceptor.start()
@@ -1188,6 +1514,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="attach a chaos plan (testing/chaos.py grammar) "
                         "to one replica, e.g. 0:crash@tick=2 — the "
                         "kill-one-mid-stream drill")
+    # ---- router crash safety (WAL + supervised failover) ----
+    p.add_argument("--supervise", action="store_true",
+                   help="run the router itself under the supervisor "
+                        "core (heartbeat watchdog + restart budget): a "
+                        "crashed router life restarts, re-adopts still-"
+                        "live replicas, recovers the dispatch WAL, and "
+                        "answers client resume verbs")
+    p.add_argument("--router-journal", default="", metavar="PATH",
+                   help="router WAL path (default: <base-dir>/"
+                        "router_journal.jsonl; 'off' disables): every "
+                        "dispatch + forwarded high-water mark, "
+                        "recovered by the next router life")
+    p.add_argument("--chaos", default="", metavar="PLAN",
+                   help="router-scoped chaos plan (testing/chaos.py "
+                        "grammar): crash@dispatch=N hard-exits the "
+                        "router after its Nth placement, conn_reset@p=X "
+                        "resets client wires probabilistically — the "
+                        "router-death and stream-resume drills")
     # ---- acting on alerts (steer / class brownout / scale) ----
     p.add_argument("--act", action=argparse.BooleanOptionalAction,
                    default=True,
@@ -1250,12 +1594,67 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def supervise_route(argv: list[str], args) -> int:
+    """`hyperion route --supervise`: the crash loop around the ROUTER —
+    the same supervisor core the router wraps around its replicas, one
+    level up. A dead router life restarts immediately (orphaned streams
+    cost fleet throughput every second; the WAL makes the restart
+    idempotent); a router whose heartbeat goes stale past
+    --hang-timeout is SIGKILLed. The restarted life re-adopts still-
+    live replicas from their heartbeats (no respawn), recovers the
+    dispatch WAL, and answers the resume verbs of reconnecting
+    clients — the doctor is consulted between lives for the verdict
+    the operator reads."""
+    from hyperion_tpu.supervisor import (
+        Decision,
+        heartbeat_watchdog,
+        run_child,
+        strip_flags,
+        supervise_loop,
+    )
+
+    def log(msg: str) -> None:
+        # stderr, always: the router's stdout is the client wire
+        print(msg, file=sys.stderr, flush=True)
+
+    base = Path(args.base_dir)
+    base.mkdir(parents=True, exist_ok=True)
+    hb_path = str(base / "heartbeat.json")
+    runner = run_child
+    if args.hang_timeout > 0:
+        runner = heartbeat_watchdog(hb_path, args.hang_timeout, log=log)
+
+    def decide(rc: int) -> Decision:
+        verdict = None
+        try:
+            from hyperion_tpu.obs.doctor import diagnose
+
+            verdict = diagnose(str(base / "telemetry.jsonl")) \
+                .get("verdict")
+        except Exception as e:  # noqa: BLE001 — triage is advisory
+            log(f"[route-supervisor] doctor consult failed: {e}")
+        log(f"[route-supervisor] router exit {rc}; doctor verdict: "
+            f"{verdict or 'unavailable'}; restarting — the new life "
+            "re-adopts live replicas and recovers the dispatch WAL")
+        return Decision.restart(immediate=True)
+
+    child_argv = strip_flags(argv, {"--supervise"}, set())
+    child = [sys.executable, "-m", "hyperion_tpu.cli.main", "route",
+             *child_argv]
+    return supervise_loop(child, decide=decide,
+                          max_restarts=args.max_restarts,
+                          run_child=runner, label="route-supervisor",
+                          log=log)
+
+
 def main(argv=None) -> int:
     import os
     import signal
 
     argv = sys.argv[1:] if argv is None else list(argv)
     args = build_parser().parse_args(argv)
+    if args.supervise:
+        return supervise_route(argv, args)
 
     from hyperion_tpu.obs import heartbeat as obs_heartbeat
     from hyperion_tpu.obs import trace as obs_trace

@@ -27,6 +27,14 @@ tokenizer is loaded. Every response line carries the request id:
     {"id": "r1", "event": "rejected"|"timed_out", "reason": "..."}
     {"id": null, "event": "error", "error": "..."}   (unparseable line)
 
+A client cut off mid-stream reconnects and sends the resume verb —
+    {"kind": "resume", "request_id": "r1", "next_index": 7,
+     "request": {...the original request line...}}
+— and receives the REST of the stream (tokens with index >= 7, then
+the terminal line) under the original id: seed-deterministic recompute
+plus stream-index dedup, the same exactly-once contract the router's
+crash failover rides (serve/client.py auto-sends this).
+
 The engine loop always runs on the main thread; transports only
 submit into the admission queue (thread-safe) and own their reply
 channels via per-request sinks. Telemetry rides the same opt-in
@@ -47,6 +55,7 @@ overload instead of collapsing.
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
 import sys
 import threading
@@ -132,6 +141,92 @@ def parse_request_line(line: str, tok=None, defaults: dict | None = None):
                 "error": f"bad request field: {e}"}
 
 
+# ------------------------------------------------------ stream resume
+#
+# The wire protocol's third verb (after request lines and the implicit
+# EOF drain): a client that lost its connection mid-stream reconnects
+# and sends
+#     {"kind": "resume", "request_id": RID, "next_index": N,
+#      "request": {...the original request line...}}
+# and gets the rest of RID's stream — tokens with index >= N, then the
+# terminal line — under the original id. The answer leans on the same
+# two invariants the router's crash failover proved (PR 9): temp-0
+# decoding is seed-deterministic (resubmitting the carried request
+# recomputes the IDENTICAL token stream, with the radix prefix cache
+# making the re-prefill cheap), and stream indices make delivery
+# dedupable (the resume sink drops everything below `next_index`).
+# The recompute runs under a suffixed wire id so the engine/journal
+# never see the same id twice (PR 9's never-go-back journal-hygiene
+# rule); the sink rewrites it back before the client sees a byte.
+
+_RESUME_SEQ = itertools.count(1)
+
+
+def maybe_resume_doc(line: str) -> dict | None:
+    """Parse `line` as a resume verb, or None (a plain request)."""
+    if '"resume"' not in line:
+        return None
+    try:
+        doc = json.loads(line)
+    except json.JSONDecodeError:
+        return None
+    if isinstance(doc, dict) and doc.get("kind") == "resume":
+        return doc
+    return None
+
+
+def resume_sink(writer, tok, rid: str, next_index: int):
+    """Sink for a resume recompute: drop already-delivered indices,
+    rewrite the suffixed wire id back to the client's."""
+    def sink(ev):
+        recs = event_record(ev, tok)
+        recs = recs if isinstance(recs, list) else [recs]
+        out = []
+        for r in recs:
+            if r.get("event") == "token":
+                i = r.get("i")
+                if isinstance(i, int) and i < next_index:
+                    continue  # the client already holds it
+            r = dict(r)
+            r["id"] = rid
+            out.append(r)
+        if out:
+            writer.write(out)
+    return sink
+
+
+def submit_resume(engine, doc: dict, writer, tok=None,
+                  defaults: dict | None = None):
+    """Answer one resume verb: resubmit the carried request under a
+    fresh wire id with a dedup-filtering sink. Returns the submitted
+    Request (for the transport's half-close bookkeeping) or None when
+    the verb was rejected on the spot."""
+    rid = str(doc.get("request_id") or "")
+    try:
+        next_index = max(0, int(doc.get("next_index", 0)))
+    except (TypeError, ValueError):
+        next_index = 0
+    carried = doc.get("request")
+    if not rid or not isinstance(carried, dict):
+        writer.write({"id": rid or None, "event": "rejected",
+                      "reason": "unknown_request"})
+        return None
+    carried = dict(carried)
+    carried["id"] = f"{rid}~r{next(_RESUME_SEQ)}"
+    parsed = parse_request_line(
+        json.dumps(carried, separators=(",", ":")), tok, defaults)
+    if isinstance(parsed, dict):  # error record
+        parsed["id"] = rid
+        engine.reject_unparsed(rid, parsed.get("error") or "")
+        writer.write(parsed)
+        return None
+    parsed.sink = resume_sink(writer, tok, rid, next_index)
+    engine.tracer.event("stream_resume", request=rid,
+                        wire_id=parsed.id, next_index=next_index)
+    engine.submit(parsed)
+    return parsed
+
+
 class _LineWriter:
     """Locked JSONL writer — transports interleave whole lines, never
     partial ones. Accepts text or binary files (socket wfile is
@@ -182,6 +277,9 @@ def serve_jsonl(engine, infile, outfile, tok=None,
                     line = line.strip()
                     if not line:
                         continue
+                    if (rdoc := maybe_resume_doc(line)) is not None:
+                        submit_resume(engine, rdoc, out, tok, defaults)
+                        continue
                     parsed = parse_request_line(line, tok, defaults)
                     if isinstance(parsed, dict):  # error record
                         engine.reject_unparsed(parsed.get("id"),
@@ -210,7 +308,7 @@ def serve_jsonl(engine, infile, outfile, tok=None,
     return summary
 
 
-def prepare_socket_path(socket_path: str) -> None:
+def prepare_socket_path(socket_path: str, bind=None):
     """Make `socket_path` bindable: a socket file that survived a
     crash (SIGKILL unlinks nothing) would fail the bind forever — the
     exact restart loop the serve supervisor runs. Probe it first: a
@@ -218,13 +316,15 @@ def prepare_socket_path(socket_path: str) -> None:
     successful connect means a live server does (refuse loudly instead
     of yanking a working deployment's socket out from under it). The
     probe discipline itself lives in obs/export.py (jax-free, shared
-    with the exposition sockets) — this is the serve-transport entry
-    point."""
+    with the exposition sockets, flock-serialized against sibling
+    restarts) — this is the serve-transport entry point. Pass the bind
+    as `bind() -> server` so it happens inside the lock; returns the
+    bound server."""
     from hyperion_tpu.obs.export import (
         prepare_socket_path as _prepare,
     )
 
-    _prepare(socket_path, owner="live server")
+    return _prepare(socket_path, owner="live server", bind=bind)
 
 
 def serve_socket(engine, socket_path: str, tok=None,
@@ -253,6 +353,12 @@ def serve_socket(engine, socket_path: str, tok=None,
                 try:
                     line = raw.decode("utf-8", "replace").strip()
                     if not line:
+                        continue
+                    if (rdoc := maybe_resume_doc(line)) is not None:
+                        resumed = submit_resume(engine, rdoc, writer,
+                                                tok, defaults)
+                        if resumed is not None:
+                            pending.append(resumed)
                         continue
                     parsed = parse_request_line(line, tok, defaults)
                     if isinstance(parsed, dict):
@@ -283,8 +389,8 @@ def serve_socket(engine, socket_path: str, tok=None,
             engine.tracer.event("client_error",
                                 client=str(client_address))
 
-    prepare_socket_path(socket_path)
-    srv = Server(socket_path, Handler)
+    srv = prepare_socket_path(
+        socket_path, bind=lambda: Server(socket_path, Handler))
     acceptor = threading.Thread(target=srv.serve_forever,
                                 name="serve-accept", daemon=True)
     acceptor.start()

@@ -39,6 +39,18 @@ or `HYPERION_CHAOS`:
                          signal handlers, no atexit, no flushes beyond
                          what already hit the kernel: the ugliest
                          process death the journal replay must survive
+    crash@dispatch=N     the same hard `os._exit`, scoped to the ROUTER:
+                         fires after the router has journaled its Nth
+                         dispatch — mid-stream router death with live
+                         replicas behind it, the exact shape the router
+                         WAL + `--supervise` failover must survive
+    conn_reset@p=X       probabilistic client-wire reset: each token
+                         about to cross a client connection flips a
+                         seeded coin and, on X, hard-resets that
+                         connection (RST, not FIN) — the flaky network
+                         the client's stream-resume path exists for.
+                         Standing (exempt from the fire-once record):
+                         every connection is at risk for the whole run
     journal_io_fail@p=X  raise OSError with probability X inside the
                          request journal's append path
                          (serve/journal.py) — durability must degrade,
@@ -95,15 +107,17 @@ _IO_CLAUSE = re.compile(r"^io_fail@p=([0-9.]+)$")
 _JOURNAL_CLAUSE = re.compile(r"^journal_io_fail@p=([0-9.]+)$")
 _POISON_CLAUSE = re.compile(r"^poison_request@id=([\w.:-]+)$")
 _TENANT_CLAUSE = re.compile(r"^slowloris@tenant=([\w.:-]+):([0-9.]+)$")
+_DISPATCH_CLAUSE = re.compile(r"^crash@dispatch=(\d+)$")
+_CONNRESET_CLAUSE = re.compile(r"^conn_reset@p=([0-9.]+)$")
 
 
 @dataclasses.dataclass(frozen=True)
 class Fault:
-    kind: str                 # kill | sigterm | nan_loss | stall | slow_client | slowloris | crash | corrupt_ckpt | io_fail | journal_io_fail | poison_request
-    step: int | None = None   # trainer step OR serve tick, per `unit`
+    kind: str                 # kill | sigterm | nan_loss | stall | slow_client | slowloris | crash | corrupt_ckpt | io_fail | journal_io_fail | conn_reset | poison_request
+    step: int | None = None   # trainer step, serve tick, or router dispatch
     secs: float = 0.0         # stall / slow_client / slowloris duration
-    p: float = 0.0            # io_fail / journal_io_fail probability
-    unit: str = "step"        # "step" (trainer loop) | "tick" (serve loop)
+    p: float = 0.0            # io_fail / journal_io_fail / conn_reset probability
+    unit: str = "step"        # "step" (trainer) | "tick" (serve) | "dispatch" (router)
     rid: str | None = None    # poison_request id OR slowloris tenant
 
     @property
@@ -111,7 +125,7 @@ class Fault:
         """Canonical id for the one-shot fire record."""
         if self.kind in ("stall", "slow_client"):
             return f"{self.kind}@{self.unit}={self.step}:{self.secs}"
-        if self.kind in ("io_fail", "journal_io_fail"):
+        if self.kind in ("io_fail", "journal_io_fail", "conn_reset"):
             return f"{self.kind}@p={self.p}"
         if self.kind == "corrupt_ckpt":
             return "corrupt_ckpt@latest"
@@ -161,14 +175,23 @@ def parse_plan(spec: str) -> list[Fault]:
         elif m := _TENANT_CLAUSE.match(clause):
             faults.append(Fault("slowloris", rid=m.group(1),
                                 secs=float(m.group(2))))
+        elif m := _DISPATCH_CLAUSE.match(clause):
+            faults.append(Fault("crash", step=int(m.group(1)),
+                                unit="dispatch"))
+        elif m := _CONNRESET_CLAUSE.match(clause):
+            p = float(m.group(1))
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"chaos clause {clause!r}: p outside [0,1]")
+            faults.append(Fault("conn_reset", p=p))
         else:
             raise ValueError(
                 f"unknown chaos clause {clause!r} (grammar: kill@step=N, "
                 "sigterm@step=N, nan_loss@step=N, stall@step=N:SECS, "
                 "kill@tick=N, sigterm@tick=N, stall@tick=N:SECS, "
                 "slow_client@tick=N:SECS, slowloris@tenant=NAME:SECS, "
-                "crash@tick=N, journal_io_fail@p=X, "
-                "poison_request@id=ID, corrupt_ckpt@latest, io_fail@p=X)")
+                "crash@tick=N, crash@dispatch=N, journal_io_fail@p=X, "
+                "conn_reset@p=X, poison_request@id=ID, "
+                "corrupt_ckpt@latest, io_fail@p=X)")
     return faults
 
 
@@ -185,6 +208,7 @@ class ChaosPlan:
         self.state_path = Path(state_path) if state_path else None
         self._rng = np.random.default_rng(seed)
         self._jrng = np.random.default_rng(seed + 1)  # journal_io_fail
+        self._crng = np.random.default_rng(seed + 2)  # conn_reset
         self._fired: set[str] = set()
         self._announced: set[str] = set()  # standing faults log once
         if self.state_path is not None and self.state_path.exists():
@@ -292,6 +316,33 @@ class ChaosPlan:
                     self._announced.add(f.key)
                     print(f"[chaos] firing {f.key} (standing)", flush=True)
                 time.sleep(f.secs)
+
+    def on_dispatch(self, n: int) -> None:
+        """crash@dispatch=N — the router's hook, called with its
+        monotonic dispatch count right after the Nth dispatch record
+        hit the WAL. Same `os._exit` semantics as crash@tick: only
+        bytes already in the kernel survive, which is exactly what the
+        dispatch/hwm fsync ordering claims is enough to recover from.
+        Fires once per lineage so the supervisor-restarted router can
+        pass the same count again without re-dying."""
+        for f in self.faults:
+            if f.kind == "crash" and f.unit == "dispatch" \
+                    and f.step == n and self._mark(f):
+                print(f"[chaos] firing {f.key}", flush=True)
+                os._exit(70)
+
+    def conn_reset(self, tag: str) -> None:
+        """conn_reset@p=X — the client-wire injector: each call (one
+        per token about to cross a client connection) flips a seeded
+        coin (its own RNG stream, so adding a reset plan never shifts
+        the io_fail/journal_io sequences) and raises
+        ConnectionResetError on X. The caller owns turning the raise
+        into a real RST on its socket."""
+        for f in self.faults:
+            if f.kind == "conn_reset" and f.p > 0.0 \
+                    and self._crng.random() < f.p:
+                raise ConnectionResetError(
+                    f"[chaos] injected conn_reset at {tag!r}")
 
     def on_request(self, request_id: str) -> None:
         """poison_request@id=ID — fired by the serve engine when the

@@ -40,7 +40,7 @@ from hyperion_tpu.serve.loadgen import SERVING_REPORT_KEYS
 # router probe); mirror them here so a rename there orphans the gate
 # loudly
 SERVING_SCALE_KEYS = ("tokens_per_s", "scaleup", "fairness",
-                      "affinity_hit_rate")
+                      "affinity_hit_rate", "duplicate_tokens")
 
 
 def synthetic_doc() -> dict:

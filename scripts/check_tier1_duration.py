@@ -33,6 +33,10 @@ DEFAULT_BUDGET_S = 850.0
 # pytest's final summary: "=== 1014 passed, 3 skipped in 782.41s (0:13:02) ==="
 _SUMMARY = re.compile(r"^=+ .*\bin (\d+(?:\.\d+)?)s(?: \([0-9:]+\))? =+")
 
+# --durations table rows: "23.45s call     tests/test_router.py::test_x"
+_DURATION = re.compile(r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)"
+                       r"\s+(\S+)")
+
 
 def tier1_wall_s(log_text: str) -> float | None:
     last = None
@@ -41,6 +45,20 @@ def tier1_wall_s(log_text: str) -> float | None:
         if m:
             last = float(m.group(1))
     return last
+
+
+def top_durations(log_text: str, n: int = 3) -> list[tuple[float, str]]:
+    """The N slowest tests from the --durations table (seconds, nodeid)
+    — setup/call/teardown summed per test so a slow fixture is charged
+    to the test that paid for it."""
+    per_test: dict[str, float] = {}
+    for line in log_text.splitlines():
+        m = _DURATION.match(line)
+        if m:
+            per_test[m.group(3)] = per_test.get(m.group(3), 0.0) \
+                + float(m.group(1))
+    ranked = sorted(per_test.items(), key=lambda kv: -kv[1])
+    return [(secs, nodeid) for nodeid, secs in ranked[:n]]
 
 
 def main(argv: list[str]) -> int:
@@ -78,6 +96,17 @@ def main(argv: list[str]) -> int:
               "no --elapsed measurement — the suite never finished "
               "(timeout?)", file=sys.stderr)
         return 1
+    # the slowest tests' share of the suite: the subprocess-heavy
+    # drills (router/supervisor acceptance) dominate tier-1 wall time,
+    # and this line makes creep visible in every run, not just over-
+    # budget ones
+    top = top_durations(text)
+    if top:
+        share = sum(s for s, _ in top) / wall if wall > 0 else 0.0
+        detail = ", ".join(f"{nodeid.rsplit('::', 1)[-1]} {s:.0f}s"
+                           for s, nodeid in top)
+        print(f"tier1-duration: top-{len(top)} tests carry "
+              f"{share:.0%} of the suite ({detail})")
     if wall > budget:
         print(f"tier1-duration: FAIL — suite took {wall:.0f}s "
               f"({source}; > {budget:.0f}s budget); see the "

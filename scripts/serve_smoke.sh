@@ -588,4 +588,246 @@ print(f"[serve_smoke] OK: acting router — doctor narrates "
       f"{sorted(tenants)}")
 PY
 
+# 11. the router itself is no longer the SPOF. Phase A: an
+#     UNSUPERVISED router over 2 replicas with router-scoped chaos
+#     (`--chaos crash@dispatch=2`) hard-exits after journaling its 2nd
+#     placement — the client holding that stream gets StreamInterrupted
+#     (never a silent half stream) and `obs doctor` must name the
+#     router crash citing the dispatch WAL's owed stream. Phase B: the
+#     SAME base dir relaunches under `route --supervise` with the same
+#     chaos; the new life re-adopts the surviving (orphaned) replicas
+#     without respawning them, recovers the WAL, and answers a bare
+#     resume verb for phase A's cut stream FROM THE WAL ALONE; then the
+#     chaos fires again mid-leg and an auto-resuming client rides the
+#     supervised restart — every stream bit-identical to the lone-
+#     engine reference, gapless and duplicate-free across three router
+#     lives.
+printf '%s\n' \
+  '{"id":"pm0","prompt_ids":[11,4,5,6],"max_new_tokens":8}' \
+  '{"id":"pm1","prompt_ids":[12,4,5,6],"max_new_tokens":8}' \
+  '{"id":"pm2","prompt_ids":[13,4,5,6],"max_new_tokens":8}' \
+  '{"id":"pm3","prompt_ids":[14,4,5,6],"max_new_tokens":8}' \
+  | python -m hyperion_tpu.cli.main serve \
+      --ckpt "$WORK/llama.npz" --no-tokenizer \
+      --max-len 64 --slots 2 --warmup-lens 8 \
+      > "$WORK/pm_ref.jsonl"
+
+# failure backstop: TERM whatever the drill left alive (supervisor,
+# router child, adopted replicas) via their heartbeat pids — a failed
+# assertion must not leak a self-restarting fleet
+cleanup_pm() {
+  [ -n "${SUP_PID:-}" ] && kill -TERM "$SUP_PID" 2>/dev/null || true
+  for hb in "$WORK"/fleet_pm/heartbeat.json \
+            "$WORK"/fleet_pm/replica_*/heartbeat.json; do
+    [ -f "$hb" ] || continue
+    pid=$(python -c \
+      "import json,sys; print(json.load(open(sys.argv[1])).get('pid', 0))" \
+      "$hb" 2>/dev/null || echo 0)
+    [ "${pid:-0}" -gt 0 ] 2>/dev/null && kill -TERM "$pid" 2>/dev/null \
+      || true
+  done
+}
+trap cleanup_pm EXIT
+
+# phase A: unsupervised, chaos armed — dispatch 2 kills the router
+python -m hyperion_tpu.cli.main route \
+    --replicas 2 --min-ready 2 --ckpt "$WORK/llama.npz" --no-tokenizer \
+    --base-dir "$WORK/fleet_pm" --max-len 64 --slots 2 \
+    --warmup-lens 8 --replica-heartbeat-every 1 \
+    --socket "$WORK/route_pm.sock" --chaos crash@dispatch=2 \
+    > "$WORK/route_pm.out" 2> "$WORK/route_pm.log" &
+PM_PID=$!
+
+python - "$WORK" <<'PY'
+import json
+import sys
+import time
+from pathlib import Path
+
+from hyperion_tpu.serve.client import ServeClient, StreamInterrupted
+
+work = Path(sys.argv[1])
+sock = work / "route_pm.sock"
+t0 = time.monotonic()
+while not sock.exists():
+    assert time.monotonic() - t0 < 240, "router socket never appeared"
+    time.sleep(0.2)
+
+with ServeClient(str(sock)) as c:
+    res = c.generate(id="pm0", prompt_ids=[11, 4, 5, 6],
+                     max_new_tokens=8)
+    assert res["final"]["event"] == "done", res
+    pm0 = res["tokens"]
+
+# pm1 is the router's 2nd dispatch: the chaos clause journals the
+# placement, then os._exit()s the router before a single token flows
+cut = None
+try:
+    with ServeClient(str(sock)) as c:
+        c.generate(id="pm1", prompt_ids=[12, 4, 5, 6],
+                   max_new_tokens=8)
+except StreamInterrupted as e:
+    cut = e
+assert cut is not None and cut.request_id == "pm1", (
+    f"expected StreamInterrupted for pm1, got {cut!r}")
+(work / "pm_state.json").write_text(json.dumps(
+    {"pm0": pm0, "next_index": cut.next_index}))
+print(f"[serve_smoke] router died owing pm1 "
+      f"(StreamInterrupted at next_index={cut.next_index})")
+PY
+wait "$PM_PID" || true
+
+# the post-mortem: doctor must cite the WAL's owed stream by name
+python -m hyperion_tpu.cli.main obs doctor "$WORK/fleet_pm" --json \
+  > "$WORK/pm_doctor.json"
+python - "$WORK/pm_doctor.json" <<'PY'
+import json
+import sys
+
+doc = json.loads(open(sys.argv[1]).read())
+wal = doc.get("router_wal")
+assert wal and wal.get("pending", 0) >= 1, (
+    f"doctor read no pending dispatch from the router WAL: {wal}")
+inc = wal.get("incident") or ""
+assert "router_journal.jsonl" in inc and "in-flight" in inc, (
+    f"doctor incident does not cite the WAL: {inc!r}")
+assert "pm1" in json.dumps(wal.get("tail", [])), (
+    f"WAL tail does not name the owed request: {wal.get('tail')}")
+print(f"[serve_smoke] OK: doctor post-mortem — {inc}")
+PY
+
+# phase B: same base dir, now SUPERVISED; attempt 0 re-arms the chaos
+# clause, so this lineage crashes once more mid-leg and the supervisor
+# restarts it immediately
+python -m hyperion_tpu.cli.main route --supervise \
+    --replicas 2 --min-ready 2 --ckpt "$WORK/llama.npz" --no-tokenizer \
+    --base-dir "$WORK/fleet_pm" --max-len 64 --slots 2 \
+    --warmup-lens 8 --replica-heartbeat-every 1 \
+    --socket "$WORK/route_pm.sock" --chaos crash@dispatch=2 \
+    > "$WORK/route_pm2.out" 2> "$WORK/route_pm2.log" &
+SUP_PID=$!
+
+python - "$WORK" <<'PY'
+import json
+import socket
+import sys
+import time
+from pathlib import Path
+
+from hyperion_tpu.serve.client import ServeClient
+
+work = Path(sys.argv[1])
+sock_path = str(work / "route_pm.sock")
+
+# the stale socket FILE survived the phase A crash — wait until a
+# router life actually answers it (the bind path's flock probe is what
+# reclaims the stale file)
+t0 = time.monotonic()
+while True:
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(1.0)
+    try:
+        s.connect(sock_path)
+        s.close()
+        break
+    except OSError:
+        s.close()
+        assert time.monotonic() - t0 < 300, "supervised router never bound"
+        time.sleep(0.2)
+
+ref = {}
+for line in open(work / "pm_ref.jsonl"):
+    rec = json.loads(line)
+    if rec.get("event") == "token" and rec.get("token") is not None:
+        ref.setdefault(rec["id"], []).append(rec["token"])
+state = json.loads((work / "pm_state.json").read_text())
+assert state["pm0"] == ref["pm0"], "phase A pm0 diverged from reference"
+
+# 1) a BARE resume verb — no request body attached: the new router
+#    life must answer it from the recovered WAL alone
+s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+s.settimeout(120.0)
+s.connect(sock_path)
+s.sendall((json.dumps({"kind": "resume", "request_id": "pm1",
+                       "next_index": state["next_index"]}) + "\n")
+          .encode())
+toks, final = [], None
+for raw in s.makefile("rb"):
+    rec = json.loads(raw)
+    if rec.get("event") == "token" and rec.get("token") is not None:
+        toks.append((rec.get("i"), rec["token"]))
+    if rec.get("event") in ("done", "rejected", "timed_out", "error"):
+        final = rec
+        break
+s.close()
+assert final and final["event"] == "done", (
+    f"WAL resume of pm1 did not complete: {final}")
+idx = [i for i, _ in toks]
+assert idx == list(range(state["next_index"], len(ref["pm1"]))), (
+    f"pm1 resume indices gapped/duplicated: {idx}")
+assert [t for _, t in toks] == ref["pm1"][state["next_index"]:], (
+    "pm1 resumed stream diverges from reference")
+
+# 2) pm2 is this life's 2nd dispatch — the chaos kills the router
+#    mid-request; the resuming client must ride the supervised restart
+#    and still produce the reference stream exactly once
+with ServeClient(sock_path, resume=True) as c:
+    res = c.generate(id="pm2", prompt_ids=[13, 4, 5, 6],
+                     max_new_tokens=8)
+assert res["final"]["event"] == "done", res
+assert res["tokens"] == ref["pm2"], (
+    f"pm2 diverged across router lives: {res['tokens']} != {ref['pm2']}")
+
+# 3) a fresh request on the restarted life — recovery left a working
+#    router behind, not just a drained WAL
+with ServeClient(sock_path, resume=True) as c:
+    res = c.generate(id="pm3", prompt_ids=[14, 4, 5, 6],
+                     max_new_tokens=8)
+assert res["final"]["event"] == "done", res
+assert res["tokens"] == ref["pm3"], "pm3 diverged after recovery"
+
+# the control-plane record must show the whole story: replicas ADOPTED
+# (not respawned) by the new lives, WAL orphans recovered, resumes
+# answered
+names = []
+for line in (work / "fleet_pm" / "telemetry.jsonl").read_text() \
+        .splitlines():
+    try:
+        names.append(json.loads(line).get("name"))
+    except json.JSONDecodeError:
+        pass
+assert names.count("replica_adopted") >= 2, (
+    f"expected both replicas adopted: {names.count('replica_adopted')}")
+assert names.count("route_orphan_recovered") >= 2, (
+    f"expected pm1+pm2 recovered from the WAL: "
+    f"{names.count('route_orphan_recovered')}")
+assert names.count("route_resume") >= 2, (
+    f"expected >=2 answered resumes: {names.count('route_resume')}")
+print("[serve_smoke] supervised drill done: pm0-pm3 bit-identical "
+      "across three router lives")
+PY
+
+# the chaos clause and the supervised restart must both have left
+# their fingerprints
+grep -q "crash@dispatch" "$WORK/route_pm2.out" || {
+  echo "[serve_smoke] FAIL: chaos clause never fired in phase B" >&2
+  exit 1
+}
+grep -q "route-supervisor] router exit" "$WORK/route_pm2.log" || {
+  echo "[serve_smoke] FAIL: no supervised restart in phase B" >&2
+  exit 1
+}
+
+# graceful teardown: TERM the router CHILD (its drain writes router_end
+# and close_clean()s the WAL); the supervisor reads exit 0 and stops
+RPID=$(python -c \
+  "import json,sys; print(json.load(open(sys.argv[1]))['pid'])" \
+  "$WORK/fleet_pm/heartbeat.json")
+kill -TERM "$RPID" 2>/dev/null || true
+wait "$SUP_PID" || true
+trap - EXIT
+
+echo "[serve_smoke] OK: router SPOF drill — WAL post-mortem, replica "
+echo "  re-adoption, and client resumes across supervised router lives"
+
 echo "[serve_smoke] all legs passed"

@@ -341,3 +341,31 @@ class TestTier1DurationGuard:
         # clock includes collection + teardown slop)
         log.write_text("== 1014 passed in 700.00s (0:11:40) ==\n")
         assert mod.main([str(log), "--elapsed", "9999"]) == 0
+
+    def test_top_durations_sums_phases_per_test(self, tmp_path, capsys):
+        # the --durations table charges setup/call/teardown separately;
+        # the guard's share line must charge a slow fixture to the test
+        # that paid for it, then rank
+        mod = self._guard()
+        table = (
+            "============ slowest 15 durations ============\n"
+            "30.00s call     tests/test_router.py::test_drill\n"
+            "12.00s setup    tests/test_router.py::test_drill\n"
+            "25.00s call     tests/test_serve.py::test_smoke\n"
+            "20.00s call     tests/test_bench.py::test_scale\n"
+            "1.50s teardown  tests/test_serve.py::test_smoke\n"
+            "9.00s call     tests/test_obs.py::test_minor\n"
+        )
+        top = mod.top_durations(table)
+        assert top == [
+            (42.0, "tests/test_router.py::test_drill"),
+            (26.5, "tests/test_serve.py::test_smoke"),
+            (20.0, "tests/test_bench.py::test_scale"),
+        ]
+        # and main() narrates the share on every run, not just failures
+        log = tmp_path / "t1.log"
+        log.write_text(table + "== 100 passed in 200.00s ==\n")
+        assert mod.main([str(log)]) == 0
+        out = capsys.readouterr().out
+        assert "top-3 tests carry 44% of the suite" in out
+        assert "test_drill 42s" in out

@@ -377,6 +377,69 @@ class TestTenantAttributionAndRouterActions:
                    for a in d["router_actions"])
 
 
+class TestRouterWalPostMortem:
+    """PR 15: a dead router life leaves its dispatch WAL next to the
+    telemetry stream. Pending entries with no `router_end` event are the
+    streams it still owes clients — the doctor must cite the WAL tail as
+    evidence, read-only (recovery belongs to the next router life)."""
+
+    def _tele(self, tmp_path, *, ended: bool):
+        clk, wall = FakeClock(100.0), FakeClock(1_000.0)
+        t = Tracer(tmp_path / "telemetry.jsonl", run="r1", proc=0,
+                   clock=clk, wall=wall)
+        t.event("router_start", replicas=2)
+        t.event("route_dispatch", request="q1", replica=1)
+        if ended:
+            t.event("router_end")
+        t.close()
+
+    def _wal(self, tmp_path, *, settle: bool):
+        from hyperion_tpu.serve.router_journal import RouterJournal
+
+        j = RouterJournal(tmp_path / "router_journal.jsonl")
+        j.dispatch("q1", line='{"id": "q1", "prompt_ids": [7]}',
+                   replica=1, session="s1")
+        j.hwm("q1", 3)
+        if settle:
+            j.done("q1", "completed")
+        j.close()
+
+    def test_orphaned_wal_becomes_the_incident(self, tmp_path):
+        self._tele(tmp_path, ended=False)
+        self._wal(tmp_path, settle=False)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        wal = d["router_wal"]
+        assert wal["pending"] == 1
+        assert "router_journal.jsonl" in wal["incident"]
+        assert "in-flight" in wal["incident"]
+        # the tail is the evidence: placement and high-water mark cited
+        assert "q1" in wal["incident"] and "i=3" in wal["incident"]
+        assert "router WAL" in d["reason"]
+        md = doctor.render_markdown(d)
+        assert "router WAL" in md and "owed streams" in md
+
+    def test_clean_router_end_makes_no_claim(self, tmp_path):
+        self._tele(tmp_path, ended=True)
+        self._wal(tmp_path, settle=False)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["router_wal"] is not None
+        assert "incident" not in d["router_wal"]
+        assert "router WAL" not in d["reason"]
+
+    def test_settled_wal_makes_no_claim(self, tmp_path):
+        self._tele(tmp_path, ended=False)
+        self._wal(tmp_path, settle=True)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["router_wal"]["pending"] == 0
+        assert "incident" not in d["router_wal"]
+
+    def test_no_wal_file_means_no_row(self, tmp_path):
+        self._tele(tmp_path, ended=False)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["router_wal"] is None
+        assert "router WAL" not in doctor.render_markdown(d)
+
+
 def write_rss_run(path, run: str, series):
     """A finished serve-shaped run whose snapshots carry the host RSS
     gauge as a SERIES — the evidence `doctor` reads for the host-leak

@@ -282,6 +282,76 @@ class TestClientReconnect:
                                           deadline_s=1.0)).connect()
         assert time.monotonic() - t0 < 2.0
 
+    @staticmethod
+    def _cutting_server(path, cut_at=2, n=6):
+        """A serve-wire server whose FIRST connection dies after
+        `cut_at` tokens; a reconnect speaking the resume verb gets the
+        suffix. Returns the thread (daemon, serves two connections)."""
+
+        def serve():
+            srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            srv.bind(path)
+            srv.listen(2)
+            for life in range(2):
+                conn, _ = srv.accept()
+                f = conn.makefile("rb")
+                doc = json.loads(f.readline())
+                if doc.get("kind") == "resume":
+                    rid = doc["request_id"]
+                    start = int(doc["next_index"])
+                else:
+                    rid, start = doc["id"], 0
+                stop = cut_at if life == 0 else n
+                for i in range(start, stop):
+                    conn.sendall((json.dumps(
+                        {"id": rid, "event": "token", "token": 100 + i,
+                         "i": i}) + "\n").encode())
+                if life == 1:
+                    conn.sendall((json.dumps(
+                        {"id": rid, "event": "done",
+                         "n_tokens": n}) + "\n").encode())
+                conn.close()
+            srv.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        return t
+
+    def test_mid_stream_cut_raises_stream_interrupted(self, tmp_path):
+        """The satellite bugfix: a wire death mid-stream must never
+        read as a short-but-clean stream — without resume the client
+        raises StreamInterrupted carrying the next index owed."""
+        from hyperion_tpu.serve.client import ServeClient, StreamInterrupted
+
+        path = str(tmp_path / "cut.sock")
+        self._cutting_server(path, cut_at=2)
+        got = []
+        with pytest.raises(StreamInterrupted) as ei:
+            with ServeClient(path, timeout_s=5.0) as c:
+                for rec in c.stream(id="r1", prompt_ids=[1],
+                                    max_new_tokens=6):
+                    got.append(rec)
+        assert [r["token"] for r in got] == [100, 101]
+        assert ei.value.request_id == "r1"
+        assert ei.value.next_index == 2
+        assert isinstance(ei.value, ConnectionError)  # failover classifiable
+
+    def test_resume_reconnects_and_dedups_to_one_stream(self, tmp_path):
+        """resume=True: the same cut turns into reconnect + resume verb
+        + index dedup — the caller sees one gapless stream and a real
+        terminal event."""
+        from hyperion_tpu.serve.client import ServeClient
+
+        path = str(tmp_path / "res.sock")
+        self._cutting_server(path, cut_at=2, n=6)
+        with ServeClient(path, timeout_s=5.0, resume=True) as c:
+            recs = list(c.stream(id="r2", prompt_ids=[1],
+                                 max_new_tokens=6))
+        toks = [r for r in recs if r.get("event") == "token"]
+        assert [r["i"] for r in toks] == list(range(6))
+        assert [r["token"] for r in toks] == [100 + i for i in range(6)]
+        assert recs[-1]["event"] == "done"
+
 
 # ------------------------------------------------- fake-replica fleet
 
@@ -353,9 +423,19 @@ class H(socketserver.StreamRequestHandler):
     def handle(self):
         for raw in self.rfile:
             doc = json.loads(raw)
+            start = 0
+            if doc.get("kind") == "resume":
+                # the wire protocol's resume verb: recompute the SAME
+                # deterministic stream, emit only the suffix the client
+                # is owed (the real server drops i < next_index the
+                # same way)
+                req = doc.get("request") or {}
+                rid = doc.get("request_id") or doc.get("id")
+                start = int(doc.get("next_index", 0))
+                doc = dict(req, id=rid)
             rid = doc["id"]; n = int(doc.get("max_new_tokens", 4))
             psum = sum(doc.get("prompt_ids", [])); seed = int(doc.get("seed", 0))
-            for i in range(n):
+            for i in range(start, n):
                 if die_after >= 0 and attempt == 0 \
                         and rid.startswith("kill") and i == die_after:
                     os._exit(1)
@@ -513,6 +593,191 @@ class TestRouterRuntime:
             assert router.submit_line("{not json", out) is None
             assert out.records[0]["event"] == "error"
             assert router.metrics.summary()["rejected"] == 1
+        finally:
+            router._hard_stop.set()
+            router.shutdown()
+
+
+# ---------------------------------------------- router WAL + resume
+
+
+class TestRouterWal:
+    """The dispatch WAL and the resume verb over the jax-free runtime:
+    what a router life journals, what the next life recovers, and how a
+    client's resume replays exactly the suffix owed."""
+
+    def test_dispatch_hwm_done_journaled_and_clean_close(self, tmp_path,
+                                                         fake_replica_script):
+        from hyperion_tpu.serve.router_journal import RouterJournal
+
+        router = _mk_router(tmp_path, fake_replica_script, n=1)
+        jpath = tmp_path / "fleet" / "router_journal.jsonl"
+        try:
+            router.start()
+            assert router.wait_ready(1, timeout_s=20)
+            out = _Recorder()
+            t = router.submit_line(json.dumps(
+                {"id": "w1", "prompt_ids": [2, 3], "max_new_tokens": 3,
+                 "seed": 1}), out)
+            t.join(timeout=20)
+            recs = [json.loads(line) for line in
+                    jpath.read_text().splitlines()]
+            kinds = [(r["k"], r.get("id")) for r in recs]
+            assert ("dispatch", "w1") in kinds
+            assert ("done", "w1") in kinds
+            hwms = [r["i"] for r in recs
+                    if r["k"] == "hwm" and r["id"] == "w1"]
+            assert hwms and hwms[-1] == 3  # every forwarded token marked
+            disp = next(r for r in recs if r["k"] == "dispatch")
+            assert json.loads(disp["line"])["id"] == "w1"  # wire line rides
+        finally:
+            router._hard_stop.set()
+            router.shutdown()
+        # the idle drain close-cleans: nothing for a next life to recover
+        orphans, clean = RouterJournal(jpath).recover()
+        assert clean and orphans == []
+
+    def test_resume_verb_replays_suffix_exactly_once(self, tmp_path,
+                                                     fake_replica_script):
+        """A client that received 4 tokens resumes {request_id,
+        next_index=4}: the router re-dispatches through the resume verb
+        with the dedup floored there — the writer sees ONLY the suffix,
+        bit-identical to the deterministic stream."""
+        router = _mk_router(tmp_path, fake_replica_script, n=2)
+        try:
+            router.start()
+            assert router.wait_ready(2, timeout_s=20)
+            out = _Recorder()
+            t = router.submit_line(json.dumps(
+                {"id": "v1", "prompt_ids": [5, 6], "max_new_tokens": 8,
+                 "seed": 3}), out)
+            t.join(timeout=20)
+            res = _Recorder()
+            t = router.submit_line(json.dumps(
+                {"kind": "resume", "request_id": "v1",
+                 "next_index": 4}), res)
+            assert t is not None
+            t.join(timeout=20)
+            toks, dones = _by_request(res.records)
+            assert dones.get("v1") == 1
+            psum, seed = 5 + 6, 3
+            assert toks["v1"] == [
+                (i, (psum * 31 + seed * 7 + i * 13) % 1000)
+                for i in range(4, 8)]
+            assert router.metrics.summary()["resumes"] == 1
+        finally:
+            router._hard_stop.set()
+            router.shutdown()
+
+    def test_resume_of_unknown_request_rejected(self, tmp_path,
+                                                fake_replica_script):
+        router = _mk_router(tmp_path, fake_replica_script, n=1)
+        try:
+            router.start()
+            out = _Recorder()
+            assert router.submit_line(json.dumps(
+                {"kind": "resume", "request_id": "ghost",
+                 "next_index": 2}), out) is None
+            assert out.records[0]["event"] == "rejected"
+            assert out.records[0]["reason"] == "unknown_request"
+        finally:
+            router._hard_stop.set()
+            router.shutdown()
+
+    def test_resume_falls_back_to_client_carried_request(self, tmp_path,
+                                                         fake_replica_script):
+        """A router life that never saw the request (fresh process, no
+        WAL record) still answers a resume that carries the original
+        request body — the client's copy is the source of last resort."""
+        router = _mk_router(tmp_path, fake_replica_script, n=1)
+        try:
+            router.start()
+            assert router.wait_ready(1, timeout_s=20)
+            out = _Recorder()
+            t = router.submit_line(json.dumps(
+                {"kind": "resume", "request_id": "c1", "next_index": 2,
+                 "request": {"prompt_ids": [7, 8], "max_new_tokens": 5,
+                             "seed": 2}}), out)
+            assert t is not None
+            t.join(timeout=20)
+            toks, dones = _by_request(out.records)
+            assert dones.get("c1") == 1
+            psum, seed = 7 + 8, 2
+            assert toks["c1"] == [
+                (i, (psum * 31 + seed * 7 + i * 13) % 1000)
+                for i in range(2, 5)]
+        finally:
+            router._hard_stop.set()
+            router.shutdown()
+
+    def test_next_life_recovers_orphans_from_wal(self, tmp_path,
+                                                 fake_replica_script):
+        """A WAL a dead router life left behind (dispatch, hwm 3, no
+        terminal) re-dispatches in jsonl mode floored at the journaled
+        hwm — the union across lives is gapless and duplicate-free."""
+        from hyperion_tpu.serve.router_journal import RouterJournal
+
+        jpath = tmp_path / "fleet" / "router_journal.jsonl"
+        jpath.parent.mkdir(parents=True)
+        dead = RouterJournal(jpath)
+        line = json.dumps({"id": "o1", "prompt_ids": [5, 6],
+                           "max_new_tokens": 8, "seed": 3})
+        dead.dispatch("o1", line=line, replica=0, session=None)
+        dead.hwm("o1", 3)
+        dead.close()  # handle closed, NO clean marker — the crash shape
+        router = _mk_router(tmp_path, fake_replica_script, n=1)
+        try:
+            router.start()
+            assert router.wait_ready(1, timeout_s=20)
+            out = _Recorder()
+            assert router.recover_journal(out) == 1
+            deadline = time.monotonic() + 20
+            while not any(r.get("event") == "done"
+                          for r in out.records):
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            toks, dones = _by_request(out.records)
+            assert dones.get("o1") == 1
+            psum, seed = 5 + 6, 3
+            assert toks["o1"] == [
+                (i, (psum * 31 + seed * 7 + i * 13) % 1000)
+                for i in range(3, 8)]
+            s = router.metrics.summary()
+            assert s["orphans_recovered"] == 1
+        finally:
+            router._hard_stop.set()
+            router.shutdown()
+
+    def test_socket_mode_parks_orphans_for_client_resume(self, tmp_path,
+                                                         fake_replica_script):
+        """Socket-mode recovery must NOT pre-emptively re-dispatch (it
+        would race the reconnecting client): orphans park until the
+        client's resume verb names them, and the client's own index —
+        not the journaled hwm — floors the replay."""
+        from hyperion_tpu.serve.router_journal import RouterJournal
+
+        jpath = tmp_path / "fleet" / "router_journal.jsonl"
+        jpath.parent.mkdir(parents=True)
+        dead = RouterJournal(jpath)
+        line = json.dumps({"id": "p1", "prompt_ids": [4, 4],
+                           "max_new_tokens": 6, "seed": 1})
+        dead.dispatch("p1", line=line, replica=0, session=None)
+        dead.hwm("p1", 4)  # hwm may run one AHEAD of the client
+        dead.close()
+        router = _mk_router(tmp_path, fake_replica_script, n=1)
+        try:
+            router.start()
+            assert router.wait_ready(1, timeout_s=20)
+            assert router.recover_journal(None) == 1  # socket mode: park
+            out = _Recorder()
+            t = router.submit_line(json.dumps(
+                {"kind": "resume", "request_id": "p1",
+                 "next_index": 3}), out)  # client is BEHIND the hwm
+            assert t is not None
+            t.join(timeout=20)
+            toks, dones = _by_request(out.records)
+            assert dones.get("p1") == 1
+            assert [i for i, _ in toks["p1"]] == [3, 4, 5]
         finally:
             router._hard_stop.set()
             router.shutdown()
@@ -682,14 +947,19 @@ class TestObsIntegration:
 
 
 class TestRouteAcceptance:
+    @pytest.mark.slow
     def test_route_kill_one_replica_bit_identical(self, tmp_path):
-        """THE acceptance subprocess test: `hyperion route` over 2
+        """The PR-9 acceptance subprocess test: `hyperion route` over 2
         supervised replicas under seeded load, replica 0 hard-crashed
         (os._exit via chaos crash@tick) mid-stream. Every admitted
         request completes with temp-0 output bit-identical to an
         uninterrupted single-engine run, no client stream carries a
         duplicate token, and the dead replica's restart shows journal
-        replay on its telemetry."""
+        replay on its telemetry.
+
+        Marked slow: the supervised-ROUTER drill below kills a layer
+        ABOVE this one and exercises the same replica failover + journal
+        machinery on its way; this drill stays for `-m slow` depth."""
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -784,6 +1054,146 @@ class TestRouteAcceptance:
 
         assert RequestJournal(
             base / "replica_0" / "journal.jsonl").pending_count() == 0
+
+    def test_route_supervised_router_crash_resume(self, tmp_path):
+        """THE acceptance drill for the router-SPOF tentpole:
+        `hyperion route --supervise` over 2 REAL replicas, the router
+        itself hard-exited mid-stream by chaos `crash@dispatch=3` while
+        4 auto-resuming clients hold streams. The supervisor restarts
+        the router; the new life re-adopts the still-live replicas
+        (no respawn, no recompile), recovers the dispatch WAL, and
+        answers the clients' resume verbs — every stream completes
+        temp-0 bit-identical to the lone-engine `generate` oracle with
+        gapless, duplicate-free indices across both router lives."""
+        import signal as signal_mod
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from hyperion_tpu.checkpoint.io import export_gathered
+        from hyperion_tpu.infer.generate import generate
+        from hyperion_tpu.models.llama import Llama, llama_tiny_config
+        from hyperion_tpu.serve.client import ServeClient
+
+        model = Llama(llama_tiny_config(max_len=64))
+        variables = {"params": model.init_params(jax.random.key(0),
+                                                 seq=8)}
+        ckpt = tmp_path / "llama.npz"
+        export_gathered(ckpt, variables["params"])
+        prompts = [np.asarray([3 + i, 4, 5, 6, 7, 8], np.int32)
+                   for i in range(4)]
+        budget = 10
+        oracle = {
+            f"s{i}": np.asarray(generate(
+                model, variables, jnp.asarray(p)[None],
+                budget))[0].tolist()
+            for i, p in enumerate(prompts)}
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="")
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        env.pop("HYPERION_TELEMETRY", None)
+        base = tmp_path / "fleet"
+        sock = str(tmp_path / "route.sock")
+        out_log = open(tmp_path / "route.out", "wb")
+        err_log = open(tmp_path / "route.err", "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "hyperion_tpu.cli.main", "route",
+             "--supervise", "--replicas", "2", "--min-ready", "2",
+             "--ckpt", str(ckpt), "--no-tokenizer",
+             "--base-dir", str(base), "--max-len", "64", "--slots", "2",
+             "--warmup-lens", "8", "--replica-heartbeat-every", "1",
+             "--socket", sock, "--chaos", "crash@dispatch=3"],
+            env=env, cwd=str(REPO), stdout=out_log, stderr=err_log,
+            start_new_session=True)
+        try:
+            t0 = time.monotonic()
+            while True:
+                probe = socket.socket(socket.AF_UNIX,
+                                      socket.SOCK_STREAM)
+                probe.settimeout(1.0)
+                try:
+                    probe.connect(sock)
+                    probe.close()
+                    break
+                except OSError:
+                    probe.close()
+                    assert proc.poll() is None, "supervisor died early"
+                    assert time.monotonic() - t0 < 240, \
+                        "router socket never came up"
+                    time.sleep(0.2)
+
+            results: dict[str, dict] = {}
+            errors: list[str] = []
+
+            def drive(i):
+                try:
+                    with ServeClient(sock, timeout_s=120.0,
+                                     resume=True) as c:
+                        results[f"s{i}"] = c.generate(
+                            id=f"s{i}",
+                            prompt_ids=prompts[i].tolist(),
+                            max_new_tokens=budget)
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors.append(f"s{i}: {e!r}")
+
+            threads = [threading.Thread(target=drive, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=240)
+            assert not errors, f"streams failed: {errors}"
+            assert not any(t.is_alive() for t in threads), \
+                "a resuming client hung"
+            for rid, ref in oracle.items():
+                res = results[rid]
+                assert res["final"]["event"] == "done", (rid, res)
+                assert res["tokens"] == ref, (
+                    f"{rid} diverged across router lives")
+
+            # the drill really happened: chaos fired (router stdout),
+            # the supervisor restarted the router (its stderr), and the
+            # new life ADOPTED the surviving replicas and answered
+            # resumes (control-plane telemetry)
+            deadline = time.monotonic() + 30
+            while True:
+                out_txt = (tmp_path / "route.out").read_text(
+                    errors="replace")
+                err_txt = (tmp_path / "route.err").read_text(
+                    errors="replace")
+                if "crash@dispatch=3" in out_txt \
+                        and "route-supervisor] router exit" in err_txt:
+                    break
+                assert time.monotonic() < deadline, (
+                    f"no crash/restart evidence:\n{err_txt[-2000:]}")
+                time.sleep(0.5)
+            names = []
+            for line in (base / "telemetry.jsonl").read_text() \
+                    .splitlines():
+                try:
+                    names.append(json.loads(line).get("name"))
+                except json.JSONDecodeError:
+                    pass
+            assert names.count("replica_adopted") >= 2, (
+                "restarted router respawned instead of adopting: "
+                f"{names.count('replica_adopted')}")
+            assert names.count("route_resume") >= 1, names
+            assert "route_orphan_recovered" in names, names
+
+            # graceful drain: TERM the router CHILD (heartbeat pid);
+            # exit 0 stops the supervisor loop
+            hb = json.loads((base / "heartbeat.json").read_text())
+            os.kill(int(hb["pid"]), signal_mod.SIGTERM)
+            assert proc.wait(timeout=60) == 0
+        finally:
+            out_log.close()
+            err_log.close()
+            try:
+                os.killpg(proc.pid, signal_mod.SIGKILL)
+            except (OSError, ProcessLookupError):
+                pass
 
 
 # ------------------------------------------- live fleet observability

@@ -199,6 +199,259 @@ class TestJournal:
         assert reused.prompt_len == 5  # the NEW life's admit record
 
 
+# ----------------------------------------------------- router journal
+
+
+class TestRouterJournal:
+    """serve/router_journal.py at the file level: the dispatch/hwm/done
+    vocabulary, orphan recovery, re-open-after-terminal, and the tail
+    reader the doctor's post-mortem cites."""
+
+    @staticmethod
+    def _wire(rid, n=8):
+        return json.dumps({"id": rid, "prompt_ids": [1, 2],
+                           "max_new_tokens": n})
+
+    def test_orphan_carries_line_replica_session_hwm(self, tmp_path):
+        from hyperion_tpu.serve.router_journal import RouterJournal
+
+        jp = tmp_path / "rj.jsonl"
+        j = RouterJournal(jp)
+        j.dispatch("a", line=self._wire("a"), replica=1, session="s1")
+        j.hwm("a", 2)
+        j.hwm("a", 3)
+        j.dispatch("b", line=self._wire("b"), replica=0, session=None)
+        j.done("b", "done")
+        j.close()
+        orphans, clean = RouterJournal(jp).recover()
+        assert not clean and [o.id for o in orphans] == ["a"]
+        (o,) = orphans
+        assert o.line == self._wire("a")  # wire line verbatim
+        assert o.doc["max_new_tokens"] == 8
+        assert (o.replica, o.session, o.hwm, o.dispatches) == (1, "s1",
+                                                               3, 1)
+
+    def test_redispatch_keeps_first_line_counts_placements(self,
+                                                           tmp_path):
+        """Failovers journal a dispatch per placement but the wire line
+        rides only the first record — the WAL must not grow by the
+        prompt on every failover."""
+        from hyperion_tpu.serve.router_journal import RouterJournal
+
+        jp = tmp_path / "rj.jsonl"
+        j = RouterJournal(jp)
+        j.dispatch("a", line=self._wire("a"), replica=0, session=None)
+        j.dispatch("a", line=self._wire("a"), replica=1, session=None,
+                   n=1)
+        j.close()
+        recs = [json.loads(line) for line in
+                jp.read_text().splitlines()]
+        assert recs[0]["line"] is not None and recs[1]["line"] is None
+        orphans, _ = RouterJournal(jp).recover()
+        assert orphans[0].dispatches == 2
+        assert orphans[0].line == self._wire("a")
+        assert orphans[0].replica == 1  # the LAST placement is evidence
+
+    def test_dispatch_after_done_reopens(self, tmp_path):
+        """A same-life resume after a client_gone terminal re-dispatches
+        the id; a router death after that must recover it — the done
+        marker is history, not a tombstone."""
+        from hyperion_tpu.serve.router_journal import RouterJournal
+
+        jp = tmp_path / "rj.jsonl"
+        j = RouterJournal(jp)
+        j.dispatch("a", line=self._wire("a"), replica=0, session=None)
+        j.hwm("a", 2)
+        j.done("a", "client_gone")
+        j.dispatch("a", line=self._wire("a"), replica=1, session=None,
+                   n=1)
+        j.hwm("a", 5)
+        j.close()
+        orphans, _ = RouterJournal(jp).recover()
+        assert [o.id for o in orphans] == ["a"]
+        assert orphans[0].hwm == 5
+        # ...and a terminal AFTER the re-open settles it again
+        j2 = RouterJournal(jp)
+        j2.done("a", "done")
+        j2.close()
+        orphans, _ = RouterJournal(jp).recover()
+        assert orphans == []
+
+    def test_clean_close_and_pending_count(self, tmp_path):
+        from hyperion_tpu.serve.router_journal import RouterJournal
+
+        jp = tmp_path / "rj.jsonl"
+        j = RouterJournal(jp)
+        j.dispatch("a", line=self._wire("a"), replica=0, session=None)
+        assert RouterJournal(jp).pending_count() == 1
+        j.done("a", "done")
+        assert RouterJournal(jp).pending_count() == 0
+        j.close_clean()
+        orphans, clean = RouterJournal(jp).recover()
+        assert clean and orphans == []
+        assert RouterJournal(jp).pending_count() == 0
+
+    def test_torn_tail_and_tail_reader(self, tmp_path):
+        from hyperion_tpu.serve.router_journal import RouterJournal
+
+        jp = tmp_path / "rj.jsonl"
+        j = RouterJournal(jp)
+        j.dispatch("a", line=self._wire("a"), replica=0, session=None)
+        j.hwm("a", 1)
+        j.close()
+        with jp.open("a") as f:
+            f.write('{"k":"hwm","id":"a","i')  # torn mid-write
+        tail = RouterJournal(jp).tail(2)
+        assert [r["k"] for r in tail] == ["dispatch", "hwm"]  # torn skipped
+        orphans, _ = RouterJournal(jp).recover()
+        assert orphans[0].hwm == 1
+
+    def test_recovery_compacts_terminal_majority(self, tmp_path):
+        """The compaction satellite on the router WAL: terminal streams
+        drop out at recovery when they dominate the file; the orphan's
+        records survive byte-exactly."""
+        from hyperion_tpu.serve.router_journal import RouterJournal
+
+        jp = tmp_path / "rj.jsonl"
+        j = RouterJournal(jp)
+        for i in range(8):
+            j.dispatch(f"d{i}", line=self._wire(f"d{i}"), replica=0,
+                       session=None)
+            j.hwm(f"d{i}", 8)
+            j.done(f"d{i}", "done")
+        j.dispatch("live", line=self._wire("live"), replica=1,
+                   session="sx")
+        j.hwm("live", 4)
+        j.close()
+        before = jp.stat().st_size
+        live_lines = [line for line in jp.read_text().splitlines()
+                      if '"live"' in line]
+        orphans, _ = RouterJournal(jp).recover()
+        assert [o.id for o in orphans] == ["live"]
+        after = jp.read_text()
+        assert jp.stat().st_size < before
+        assert "d0" not in after and "d7" not in after
+        for line in live_lines:  # pending work preserved byte-exactly
+            assert line in after
+
+
+# --------------------------------------------- WAL byte-boundary fuzz
+
+
+class TestWalByteFuzz:
+    """The property satellite: a WAL truncated at EVERY byte boundary
+    (any crash point) must recover to exactly the state its complete-
+    line prefix describes — no phantom request, no duplicate or phantom
+    token, hwm never past what was durably written — for BOTH the
+    replica journal and the router WAL."""
+
+    @staticmethod
+    def _complete_lines(prefix: bytes):
+        """The records recovery may legally see: every newline-
+        terminated line, plus the torn last line iff it parses — a
+        strict prefix of a JSON dict is only valid at its final `}`, so
+        this admits exactly the case where the crash ate only the
+        trailing newline."""
+        segs = prefix.split(b"\n")
+        out = []
+        for raw in segs[:-1]:
+            if raw.strip():
+                out.append(json.loads(raw))
+        if segs[-1].strip():
+            try:
+                out.append(json.loads(segs[-1]))
+            except ValueError:
+                pass
+        return out
+
+    def test_replica_journal_recovers_exact_prefix(self, tmp_path):
+        import random
+
+        rng = random.Random(7)
+        jp = tmp_path / "full.jsonl"
+        j = RequestJournal(jp)
+        live: list[str] = []
+        nxt = iter(f"r{i}" for i in range(99))
+        for _ in range(18):
+            roll = rng.random()
+            if roll < 0.3 or not live:
+                rid = next(nxt)
+                j.admit(_req(3, rid, max_new_tokens=50))
+                live.append(rid)
+            elif roll < 0.85:
+                j.token(rng.choice(live), rng.randrange(1000))
+            else:
+                j.finish(live.pop(rng.randrange(len(live))), "done")
+        j.close()
+        blob = jp.read_bytes()
+
+        for cut in range(len(blob) + 1):
+            tp = tmp_path / "t.jsonl"
+            tp.write_bytes(blob[:cut])
+            admits, toks, dones = [], {}, set()
+            for rec in self._complete_lines(blob[:cut]):
+                if rec["k"] == "admit":
+                    admits.append(rec["id"])
+                elif rec["k"] == "tok":
+                    toks.setdefault(rec["id"], []).append(rec["tok"])
+                elif rec["k"] == "done":
+                    dones.add(rec["id"])
+            resume, finished, poisoned, clean = \
+                RequestJournal(tp).recover()
+            assert not clean and not finished and not poisoned, cut
+            want = [rid for rid in admits if rid not in dones]
+            assert [r.id for r in resume] == want, cut
+            for r in resume:  # prefix-consistent payload, no dup/phantom
+                assert r.tokens == toks.get(r.id, []), (cut, r.id)
+
+    def test_router_journal_recovers_exact_prefix(self, tmp_path):
+        import random
+
+        from hyperion_tpu.serve.router_journal import RouterJournal
+
+        rng = random.Random(11)
+        jp = tmp_path / "full.jsonl"
+        j = RouterJournal(jp)
+        live: list[str] = []
+        nxt = iter(f"q{i}" for i in range(99))
+        for _ in range(18):
+            roll = rng.random()
+            if roll < 0.3 or not live:
+                rid = next(nxt)
+                j.dispatch(rid, line=json.dumps({"id": rid}),
+                           replica=rng.randrange(2), session=None)
+                live.append(rid)
+            elif roll < 0.85:
+                j.hwm(rng.choice(live), rng.randrange(12))
+            else:
+                j.done(live.pop(rng.randrange(len(live))), "done")
+        j.close()
+        blob = jp.read_bytes()
+
+        for cut in range(len(blob) + 1):
+            tp = tmp_path / "t.jsonl"
+            tp.write_bytes(blob[:cut])
+            order, lines, hwms, dones = [], {}, {}, set()
+            for rec in self._complete_lines(blob[:cut]):
+                if rec["k"] == "dispatch":
+                    if rec["id"] not in lines:
+                        order.append(rec["id"])
+                        lines[rec["id"]] = rec["line"]
+                    dones.discard(rec["id"])  # re-open semantics
+                elif rec["k"] == "hwm":
+                    hwms[rec["id"]] = max(hwms.get(rec["id"], 0),
+                                          rec["i"])
+                elif rec["k"] == "done":
+                    dones.add(rec["id"])
+            orphans, clean = RouterJournal(tp).recover()
+            assert not clean, cut
+            want = [rid for rid in order if rid not in dones]
+            assert [o.id for o in orphans] == want, cut
+            for o in orphans:
+                assert o.line == lines[o.id], cut  # no phantom payload
+                assert o.hwm == hwms.get(o.id, 0), (cut, o.id)
+
+
 # ---------------------------------------------------- brownout governor
 
 
@@ -301,6 +554,60 @@ class TestServeChaosGrammar:
     def test_crash_is_tick_scoped_only(self):
         with pytest.raises(ValueError, match="unknown chaos clause"):
             chaos.parse_plan("crash@step=3")
+
+    def test_crash_dispatch_clause_parses_router_scoped(self):
+        (f,) = chaos.parse_plan("crash@dispatch=3")
+        assert (f.kind, f.unit, f.step) == ("crash", "dispatch", 3)
+        assert f.key == "crash@dispatch=3"
+        # dispatch-scoped: the serve tick hook must NOT fire it (it
+        # would os._exit — surviving the call IS the assertion)
+        plan = chaos.ChaosPlan([f])
+        plan.on_tick(3)
+        plan.on_step(3)
+        plan.on_dispatch(2)  # wrong count: no fire
+        assert not plan._fired
+
+    def test_conn_reset_clause_validates_and_draws_own_stream(self):
+        with pytest.raises(ValueError, match="outside"):
+            chaos.parse_plan("conn_reset@p=1.5")
+        plan = chaos.ChaosPlan(chaos.parse_plan("conn_reset@p=1.0"))
+        with pytest.raises(ConnectionResetError):
+            plan.conn_reset("route_client_write")
+        never = chaos.ChaosPlan(chaos.parse_plan("conn_reset@p=0.0"))
+        for _ in range(64):
+            never.conn_reset("route_client_write")
+        # its own RNG stream: adding a reset plan must not shift the
+        # io_fail draw sequence other tests pinned
+        a = chaos.ChaosPlan(chaos.parse_plan("io_fail@p=0.5"), seed=3)
+        b = chaos.ChaosPlan(
+            chaos.parse_plan("io_fail@p=0.5,conn_reset@p=0.5"), seed=3)
+        seq_a, seq_b = [], []
+        for _ in range(32):
+            for plan, seq in ((a, seq_a), (b, seq_b)):
+                try:
+                    plan.io_fail("t")
+                    seq.append(0)
+                except OSError:
+                    seq.append(1)
+            try:
+                b.conn_reset("t")
+            except ConnectionResetError:
+                pass
+        assert seq_a == seq_b
+
+    def test_crash_dispatch_fires_once_per_lineage(self, tmp_path):
+        """The supervised-router contract: a restarted life (same state
+        path) passing the same dispatch count again must NOT re-die —
+        proven in-process via the fire record, since the fire itself is
+        os._exit."""
+        state = tmp_path / "chaos_state.json"
+        plan = chaos.ChaosPlan(chaos.parse_plan("crash@dispatch=3"),
+                               state_path=state)
+        plan._mark(plan.faults[0])  # what the dying life wrote
+        life2 = chaos.ChaosPlan(chaos.parse_plan("crash@dispatch=3"),
+                                state_path=state)
+        life2.on_dispatch(3)  # surviving the call IS the assertion
+        assert "crash@dispatch=3" in life2._fired
 
     def test_journal_p_validated(self):
         with pytest.raises(ValueError, match="outside"):
