@@ -524,6 +524,13 @@ def _child_serving_scale() -> None:
         # exactly-once audit from the CLIENT side of the fleet run:
         # stream-indexed duplicate deliveries (obs diff zero-pins it)
         "duplicate_tokens": repn.get("duplicate_tokens", 0),
+        # cross-process tracing keys (obs diff gates both): router
+        # overhead as the CLIENT measured it (its TTFT minus the
+        # replica-attributed ttft_ms on the done record), and the p99
+        # failover gap off the router's own histogram (0.0 on a round
+        # with no failover — the gate stays live either way)
+        "router_overhead_p99_ms": repn.get("router_overhead_p99_ms"),
+        "failover_gap_p99_ms": endn.get("failover_gap_p99_ms", 0.0),
     }))
 
 

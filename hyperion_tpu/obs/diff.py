@@ -111,6 +111,14 @@ METRICS: dict[str, str] = {
     # stream-indexed duplicate deliveries the CLIENTS observed across
     # the fleet run — zero-pinned, one duplicate is a dedup bug
     "serve_duplicate_tokens": "lower",
+    # cross-process tracing (PR 16, the bench serving_scale row):
+    # router overhead the CLIENT observes (client TTFT minus the
+    # replica-attributed TTFT) and the p99 failover gap (replica death
+    # detected -> first record from the replacement). Both are time
+    # the fleet spends BETWEEN processes — invisible to every
+    # per-process gate above, so they get their own
+    "serve_router_overhead_p99_ms": "lower",
+    "serve_failover_gap_p99_ms": "lower",
 }
 
 # metrics whose healthy value is exactly zero: the percent-threshold
@@ -216,7 +224,11 @@ def normalize(doc: dict) -> dict[str, float]:
                               ("affinity_hit_rate",
                                "serve_affinity_hit_rate"),
                               ("duplicate_tokens",
-                               "serve_duplicate_tokens")):
+                               "serve_duplicate_tokens"),
+                              ("router_overhead_p99_ms",
+                               "serve_router_overhead_p99_ms"),
+                              ("failover_gap_p99_ms",
+                               "serve_failover_gap_p99_ms")):
                 v = _num(scale.get(src))
                 if v is not None:
                     out[name] = v

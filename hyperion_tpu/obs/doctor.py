@@ -608,6 +608,32 @@ def diagnose(
     if fleet_incidents:
         reason += "; fleet: " + "; ".join(fleet_incidents)
 
+    # Cross-process tail attribution (the hop-context join): with
+    # replica dirs on disk and completed relays on this stream, the
+    # fleet assembler decomposes CLIENT-observed tails into router /
+    # wire / replica / failover components — the dominant one names an
+    # incident no single process's own attribution can see ("p99 e2e
+    # dominated by failover_gap — replica restarts too slow").
+    fleet_trace_rows: list[dict] = []
+    fleet_trace_incidents: list[str] = []
+    if fleet_rows and any(e.get("name") == "route_complete"
+                          for e in events):
+        try:
+            from hyperion_tpu.obs import fleet_trace as fleet_mod
+
+            asm = fleet_mod.assemble(Path(tele_path).parent)
+            if asm is not None:
+                att = fleet_mod.attribution(asm)
+                fleet_trace_rows = att["rows"]
+                fleet_trace_incidents = fleet_mod.tail_incidents(
+                    att["rows"])
+        except Exception:  # noqa: BLE001 — partial fleet evidence must
+            pass           # degrade the join, never the diagnosis
+    if fleet_trace_incidents and verdict in (
+            "healthy", "running", "stalled", "failed", "crashed",
+            "hung"):
+        reason += "; fleet trace: " + "; ".join(fleet_trace_incidents)
+
     # Router WAL post-mortem (PR 15): a dead router LIFE leaves its
     # dispatch WAL next to the stream — pending (dispatched, never
     # terminal) entries are the streams it still owes clients, and the
@@ -890,6 +916,10 @@ def diagnose(
         "slo_incidents": slo_incidents,
         "fleet": fleet_rows,
         "fleet_incidents": fleet_incidents,
+        # cross-process trace join (PR 16): client-observed tails
+        # decomposed across router, wire, replicas, and failover
+        "fleet_trace": fleet_trace_rows,
+        "fleet_trace_incidents": fleet_trace_incidents,
         # router crash safety (PR 15): the dispatch WAL's post-mortem
         "router_wal": router_wal,
         # workload-isolation plane (PR 14): who drove the pressure and
@@ -1106,6 +1136,19 @@ def render_markdown(d: dict) -> str:
             f"rejected {row['rejected']}{flag} |")
     for act in d.get("router_actions") or []:
         lines.append(f"| router action | {act} |")
+    for row in d.get("fleet_trace") or []:
+        if row.get("q") != 99:
+            continue
+        comps = ", ".join(f"{p} {v:.1f}"
+                          for p, v in row["components_ms"].items() if v)
+        flag = (" — **incident**" if any(
+            row["metric"] in inc
+            for inc in d.get("fleet_trace_incidents") or ()) else "")
+        lines.append(
+            f"| fleet p{row['q']} {row['metric']} | "
+            f"{row['value_ms']:.1f} ms across processes: {comps}, "
+            f"other {row['other_ms']:.1f} (dominant "
+            f"`{row['dominant']}`){flag} |")
     wal = d.get("router_wal")
     if wal:
         lines.append(

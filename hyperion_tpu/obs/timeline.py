@@ -129,6 +129,20 @@ def replica_of_run(run: str | None) -> int | None:
     return int(m.group(1)) if m else None
 
 
+# the resume-suffix grammar: `serve/server.py:submit_resume` mints
+# `{rid}~rN` (N >= 1) wire ids so a resumed recompute never collides
+# with the original id on the engine journal. One client request —
+# however many resumes — must fold into ONE RequestTrace here, or the
+# attribution tables double-count every resumed stream.
+_RESUME_SUFFIX = re.compile(r"~r\d+$")
+
+
+def base_request_id(rid: str) -> str:
+    """Strip the resume suffix (`abc~r2` -> `abc`); identity for
+    unsuffixed ids. The inverse of `submit_resume`'s minting."""
+    return _RESUME_SUFFIX.sub("", rid)
+
+
 def requests_from_records(records: list[dict],
                           run: str | None = None) -> list[RequestTrace]:
     """Rebuild per-request timelines from one run of a telemetry
@@ -148,7 +162,7 @@ def requests_from_records(records: list[dict],
     pending_queue: dict[str, float] = {}   # id -> queue-segment start
     decode_start: dict[str, float] = {}    # id -> decode-segment start
     for r in recs:
-        rid = str(r["request"])
+        rid = base_request_id(str(r["request"]))
         rt = out.setdefault(rid, RequestTrace(id=rid, replica=replica))
         t = float(r["t_mono"])
         name = r.get("name")
@@ -501,6 +515,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("target", help="run directory (containing "
                                   "telemetry.jsonl) or a telemetry.jsonl")
+    p.add_argument("--fleet", action="store_true",
+                   help="treat target as a ROUTER base dir (router "
+                        "stream + replica_*/ telemetry dirs) and "
+                        "assemble one cross-process fleet trace "
+                        "(obs/fleet_trace.py) instead of a single-"
+                        "process waterfall")
     p.add_argument("--run", default=None,
                    help="run id (default: last run with request events)")
     p.add_argument("--export", default=None, metavar="PATH",
@@ -517,6 +537,10 @@ def main(argv=None) -> int:
     from hyperion_tpu.obs.report import read_records
 
     args = build_parser().parse_args(argv)
+    if args.fleet:
+        from hyperion_tpu.obs import fleet_trace
+
+        return fleet_trace.run_cli(args)
     target = Path(args.target)
     tele = target / "telemetry.jsonl" if target.is_dir() else target
     if not tele.exists():

@@ -388,6 +388,15 @@ class TokenEvent:
     reason: str | None = None
 
 
+def _tr(req) -> dict:
+    """The request's fleet hop context as event attrs. Every
+    request-scoped event splats this so a router-dispatched request's
+    replica-side lifecycle joins the fleet trace by id; {} for direct
+    clients, so local-only runs pay zero extra bytes."""
+    trace = getattr(req, "trace", None)
+    return {"trace": trace} if trace else {}
+
+
 class Engine:
     """Continuous-batching engine over one model + one variables tree.
 
@@ -905,7 +914,8 @@ class Engine:
             self.tracer.event(
                 "prefill_chunked", request=req.id, tick=self._tick_no,
                 slot=slot, prompt_len=P, cached_tokens=start, chunk=C,
-                segments=-(-(P - start) // C), resumed=resumed)
+                segments=-(-(P - start) // C), resumed=resumed,
+                **_tr(req))
             return _CHUNK_ADMIT
         self._bt[slot, :len(seq.blocks)] = seq.blocks
         self._bt[slot, len(seq.blocks):] = 0
@@ -934,7 +944,7 @@ class Engine:
                 ttft_s=round(now - req.submitted_at, 6),
                 queue_wait_s=round(req.queue_wait_s, 6),
                 gate_wait_s=round(req.gate_wait_s, 6),
-                prefill_s=round(req.prefill_s, 6))
+                prefill_s=round(req.prefill_s, 6), **_tr(req))
         else:
             gap_from = getattr(req, "_last_emit_at", None)
             if gap_from is not None:
@@ -1015,7 +1025,8 @@ class Engine:
                 ttft_s=round(now - req.submitted_at, 6),
                 queue_wait_s=round(req.queue_wait_s, 6),
                 gate_wait_s=round(req.gate_wait_s, 6),
-                prefill_s=round(req.prefill_s, 6), chunked=True)
+                prefill_s=round(req.prefill_s, 6), chunked=True,
+                **_tr(req))
         else:
             gap_from = getattr(req, "_last_emit_at", None)
             if gap_from is not None:
@@ -1041,7 +1052,8 @@ class Engine:
         req._preempted = True  # its next queue wait is replay, not FIFO
         self.tracer.event("request_preempted", request=req.id,
                           generated=len(req.tokens), tick=self._tick_no,
-                          reason=reason, sla_class=req.sla_class)
+                          reason=reason, sla_class=req.sla_class,
+                          **_tr(req))
         self.queue.push_front(req)
 
     def _account_pop(self, req) -> bool:
@@ -1077,7 +1089,8 @@ class Engine:
             resumed=resumed,
             queue_wait_s=round(0.0 if resumed else wait - gate, 6),
             gate_wait_s=round(0.0 if resumed else gate, 6),
-            replay_wait_s=round(wait if resumed else 0.0, 6))
+            replay_wait_s=round(wait if resumed else 0.0, 6),
+            **_tr(req))
         return resumed
 
     def _ensure_blocks(self) -> None:
@@ -1186,7 +1199,7 @@ class Engine:
                 req.sink = None
                 self.metrics.on_dropped_sink()
                 self.tracer.event("client_disconnected", request=req.id,
-                                  tick=self._tick_no)
+                                  tick=self._tick_no, **_tr(req))
             # charge transport time to the REQUEST (a slow client must
             # show up in its own tail attribution, not vanish into the
             # decode gap it inflates)
@@ -1236,6 +1249,7 @@ class Engine:
             ttft_s=(round(req.first_token_at - req.submitted_at, 6)
                     if req.first_token_at is not None else None),
             **{f"{p}_s": round(v, 6) for p, v in req.phases_s().items()},
+            **_tr(req),
         )
 
     # -------------------------------------------------------- public api
@@ -1283,7 +1297,8 @@ class Engine:
                               **({"tenant": req.tenant}
                                  if req.tenant else {}),
                               **({"clamped_from": req.clamped_from}
-                                 if req.clamped_from is not None else {}))
+                                 if req.clamped_from is not None else {}),
+                              **_tr(req))
         else:
             # queued_s: rejection happens at the door, so the request
             # spent zero time queued — the key exists so rejects land in
@@ -1295,7 +1310,7 @@ class Engine:
                               sla_class=req.sla_class,
                               **({"tenant": req.tenant}
                                  if req.tenant else {}),
-                              queued_s=0.0)
+                              queued_s=0.0, **_tr(req))
             self._emit(TokenEvent(req, None, True, kind="rejected",
                                   reason=reason))
         return ok, reason
@@ -1365,7 +1380,7 @@ class Engine:
                 "request_finished", request=req.id, tick=self._tick_no,
                 reason="recovered_complete", prompt_len=req.prompt_len,
                 n_tokens=len(req.tokens), preempts=req.preempts,
-                replayed=True)
+                replayed=True, **_tr(req))
             self._emit(TokenEvent(req, None, True, kind="done",
                                   reason="recovered_complete"))
         for req in poisoned:
@@ -1375,7 +1390,8 @@ class Engine:
             self.metrics.on_poisoned()
             self.tracer.event(
                 "request_poisoned", request=req.id, replays=req.replays,
-                prompt_len=req.prompt_len, generated=len(req.tokens))
+                prompt_len=req.prompt_len, generated=len(req.tokens),
+                **_tr(req))
             self._emit(TokenEvent(req, None, True, kind="rejected",
                                   reason=REJECT_POISONED))
         for req in reversed(resume):  # reversed: first-admitted at head
@@ -1393,7 +1409,8 @@ class Engine:
                 prompt_len=req.prompt_len,
                 max_new_tokens=req.max_new_tokens,
                 deadline_s=req.deadline_s, replayed=True,
-                replay_n=req.replays, generated=len(req.tokens))
+                replay_n=req.replays, generated=len(req.tokens),
+                **_tr(req))
             self.queue.push_front(req)
         if resume or finished or poisoned:
             self.tracer.event("journal_replayed", resumed=len(resume),
@@ -1648,7 +1665,7 @@ class Engine:
             queued = round(max(0.0, now - req.enqueued_at), 6)
             self.tracer.event("request_timeout", request=req.id,
                               waited_s=round(now - req.submitted_at, 3),
-                              queued_s=queued)
+                              queued_s=queued, **_tr(req))
             ev = TokenEvent(req, None, True, kind="timed_out",
                             reason="deadline exceeded in queue")
             self._emit(ev)

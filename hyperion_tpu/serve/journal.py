@@ -122,7 +122,7 @@ class RequestJournal:
         """Record an accepted request — durable before its first token
         can reference it. Sampling params and the seed ride along so a
         replay reconstructs the identical PRNG stream."""
-        self._append({
+        rec = {
             "k": "admit", "id": req.id,
             "prompt_ids": np.asarray(req.prompt_ids).tolist(),
             "max_new_tokens": int(req.max_new_tokens),
@@ -131,7 +131,12 @@ class RequestJournal:
             "seed": int(req.seed),
             "deadline_s": (float(req.deadline_s)
                            if req.deadline_s is not None else None),
-        }, sync=True)
+        }
+        if getattr(req, "trace", None):
+            # the fleet hop context survives a crash with the request,
+            # so a replayed request's events still join the fleet trace
+            rec["trace"] = req.trace
+        self._append(rec, sync=True)
 
     def token(self, rid: str, tok: int) -> None:
         self._append({"k": "tok", "id": rid, "tok": int(tok)}, sync=False)
@@ -320,6 +325,8 @@ class RequestJournal:
                 # a replayed request gets its deadline re-anchored to
                 # re-admission — a second chance, not a free pass
                 deadline_s=a.get("deadline_s"),
+                trace=(a["trace"] if isinstance(a.get("trace"), dict)
+                       else None),
             )
             req.tokens = list(st["tokens"])
             req.replays = st["replays"]

@@ -425,6 +425,13 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
                                 "error"):
                         res["status"] = ev
                         res["finished_at"] = time.monotonic()
+                        # replica-attributed TTFT rides the done record
+                        # (serve/server.py): client TTFT minus this is
+                        # the time the router + wire owned the request
+                        if isinstance(rec.get("ttft_ms"),
+                                      (int, float)):
+                            res["replica_ttft_ms"] = float(
+                                rec["ttft_ms"])
         except (OSError, ConnectionError) as e:
             res["status"] = "error"
             res["error"] = repr(e)
@@ -457,6 +464,16 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
     ttft_win = [(r["first_token_at"] - r["submitted_at"]) * 1e3
                 for r in done
                 if "first_token_at" in r and r["first_token_at"] >= cut]
+    # router overhead the CLIENT observed: its own TTFT minus the
+    # replica-attributed TTFT the done record carried. Everything the
+    # router + wire added — placement, WAL, dispatch gap, relay copies
+    # — and nothing the engine did. Directly comparable across fleet
+    # sizes, and gated in `obs diff` as serve_router_overhead_p99_ms.
+    overhead_ms = [
+        max(0.0, (r["first_token_at"] - r["submitted_at"]) * 1e3
+            - r["replica_ttft_ms"])
+        for r in done
+        if "first_token_at" in r and "replica_ttft_ms" in r]
     tokens = sum(r.get("tokens", 0) for r in done)
     rejected = sum(1 for r in results
                    if r.get("status") in ("rejected", "error"))
@@ -480,6 +497,8 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
         "e2e_p99_ms": round(percentile(e2e_ms, 99), 3) if e2e_ms else None,
         "ttft_p99_windowed_ms": round(percentile(ttft_win, 99), 3)
         if ttft_win else None,
+        "router_overhead_p99_ms": round(percentile(overhead_ms, 99), 3)
+        if overhead_ms else None,
         "elapsed_s": round(elapsed, 3),
         "arrival_rate_hz": spec.rate_hz,
         "shared_prefix_tokens": spec.shared_prefix_tokens,

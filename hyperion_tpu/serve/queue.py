@@ -82,6 +82,7 @@ class Request:
     deadline_s: float | None = None      # SLO relative to submission
     sla_class: str = CLASS_INTERACTIVE   # interactive | batch
     tenant: str | None = None            # workload attribution label
+    trace: dict | None = None            # fleet hop context (router-stamped)
     sink: Callable[[dict], Any] | None = None
 
     # --- runtime state (engine-owned) ---

@@ -396,6 +396,12 @@ class Router:
         self.tracer = tracer
         self.hb = hb
         self.metrics = metrics or RouterMetrics()
+        # which supervised life of this router is running (the
+        # supervisor stamps HYPERION_ATTEMPT per restart): rides every
+        # hop context so a fleet trace can tell "dispatched before the
+        # router crash" from "re-dispatched by the next life"
+        self.router_life = int(
+            os.environ.get("HYPERION_ATTEMPT", "0") or 0)
         # injectable child command (tests run the router runtime over
         # jax-free fake replicas that speak the wire protocol)
         self._child_argv_fn = child_argv_fn
@@ -985,11 +991,11 @@ class Router:
 
     def _relay(self, rid: str, doc: dict, writer, *,
                resume_from: int = 0, wal_line: str | None = None,
-               as_resume: bool = False) -> None:
+               as_resume: bool = False, hop_base: int = 0) -> None:
         try:
             self._relay_inner(rid, doc, _ClientWriter(writer),
                               resume_from=resume_from, wal_line=wal_line,
-                              as_resume=as_resume)
+                              as_resume=as_resume, hop_base=hop_base)
         except ClientGone as e:
             # the CLIENT vanished mid-stream: its request dies with it
             # (nothing left to deliver to), the replica keeps serving —
@@ -1016,7 +1022,7 @@ class Router:
 
     def _relay_inner(self, rid: str, doc: dict, writer, *,
                      resume_from: int = 0, wal_line: str | None = None,
-                     as_resume: bool = False) -> None:
+                     as_resume: bool = False, hop_base: int = 0) -> None:
         submitted = time.monotonic()
         dedup = StreamDedup()
         # a resume (client-driven or WAL orphan re-dispatch) floors the
@@ -1031,6 +1037,20 @@ class Router:
         redispatches = 0
         saw_qfull = False
         backoff = 0.05
+        # failover-gap clock: starts the instant a replica death is
+        # detected, stops at the FIRST record the client sees from the
+        # replacement — connect retries against a restarting replica
+        # ARE the gap, so the stop lives inside the next stream
+        fail_at: float | None = None
+
+        def _gap_done() -> None:
+            nonlocal fail_at
+            if fail_at is not None:
+                self.metrics.on_failover_gap(time.monotonic() - fail_at)
+                fail_at = None
+
+        trace: dict = {"id": rid, "hop": hop_base, "attempt": 0,
+                       "router_life": self.router_life}
         while True:
             if self._hard_stop.is_set():
                 self._reject(rid, REJECT_DRAINING, submitted, writer)
@@ -1053,11 +1073,22 @@ class Router:
                 continue
             self.metrics.on_dispatch(rep.index, meta["affinity_hit"],
                                      meta["had_key"])
+            # the hop context: trace id = the minted request id; `hop`
+            # counts placements across the request's WHOLE journey
+            # (resume relays continue past the legs a previous relay
+            # already burned via hop_base), `attempt` counts
+            # re-dispatch retries within THIS relay
+            trace = {"id": rid, "hop": hop_base + redispatches,
+                     "attempt": redispatches,
+                     "router_life": self.router_life}
             self.tracer.event(
                 "route_dispatch", request=rid, replica=rep.index,
-                affinity=meta["affinity_hit"], redispatch=redispatches)
+                affinity=meta["affinity_hit"], redispatch=redispatches,
+                trace=trace)
             # WAL before wire: the placement is durable before the
-            # replica can possibly have seen the request
+            # replica can possibly have seen the request. The stored
+            # line stays the request exactly as the client sent it —
+            # the hop context rides a separate record field.
             if self.journal is not None:
                 self.journal.dispatch(
                     rid,
@@ -1065,15 +1096,18 @@ class Router:
                           else json.dumps(doc, separators=(",", ":"))),
                     replica=rep.index,
                     session=self.policy.affinity_key(doc),
-                    n=redispatches)
+                    n=redispatches, trace=trace)
             if self.chaos is not None:
                 # counts every placement router-wide — the
                 # crash@dispatch=N drill's trigger
                 self.chaos.on_dispatch(next(self._dispatch_n))
+            send_doc = dict(doc)
+            send_doc["trace"] = trace
             try:
-                outcome, terminal = self._stream_from(rep, rid, doc,
+                outcome, terminal = self._stream_from(rep, rid, send_doc,
                                                       dedup, writer,
-                                                      as_resume=as_resume)
+                                                      as_resume=as_resume,
+                                                      gap_cb=_gap_done)
             except (OSError, ConnectionError, ValueError) as e:
                 # mid-stream death (or connect that never came up):
                 # eject, fail over. The renewed deadline is deliberate —
@@ -1083,11 +1117,14 @@ class Router:
                                  f"({e.__class__.__name__})")
                 crashed.add(rep.index)
                 redispatches += 1
+                if fail_at is None:
+                    fail_at = time.monotonic()
                 self.metrics.on_redispatch("replica_lost")
                 self.tracer.event("route_redispatch", request=rid,
                                   from_replica=rep.index,
                                   reason="replica_lost",
-                                  delivered=dedup.delivered)
+                                  delivered=dedup.delivered,
+                                  trace=trace)
                 deadline = max(deadline, time.monotonic()
                                + self.args.dispatch_timeout)
                 continue
@@ -1103,7 +1140,8 @@ class Router:
                 self.metrics.on_redispatch(REJECT_QUEUE_FULL)
                 self.tracer.event("route_redispatch", request=rid,
                                   from_replica=rep.index,
-                                  reason=REJECT_QUEUE_FULL)
+                                  reason=REJECT_QUEUE_FULL,
+                                  trace=trace)
                 continue
             self.metrics.on_complete()
             if self.journal is not None:
@@ -1112,12 +1150,14 @@ class Router:
                 "route_complete", request=rid, replica=rep.index,
                 status=outcome, tokens=dedup.delivered,
                 redispatches=redispatches,
-                e2e_s=round(time.monotonic() - submitted, 6))
+                e2e_s=round(time.monotonic() - submitted, 6),
+                trace=trace)
             return
 
     def _stream_from(self, rep: ReplicaHandle, rid: str, doc: dict,
                      dedup: StreamDedup, writer,
-                     as_resume: bool = False) -> tuple[str, dict]:
+                     as_resume: bool = False,
+                     gap_cb=None) -> tuple[str, dict]:
         """One dispatch attempt: open the replica stream, forward
         deduplicated records to the client. Returns (outcome, terminal
         record) where outcome is the terminal event name or
@@ -1140,6 +1180,10 @@ class Router:
             else:
                 stream = client.stream(**doc)
             for rec in stream:
+                if gap_cb is not None:
+                    # first record from this replica closes any open
+                    # failover gap (no-op when none is running)
+                    gap_cb()
                 ev = rec.get("event")
                 if ev == "token":
                     if dedup.admit(rec):
@@ -1199,14 +1243,15 @@ class Router:
             return None
         self.metrics.on_resume()
         self.tracer.event("route_resume", request=rid,
-                          next_index=next_index)
+                          next_index=next_index,
+                          router_life=self.router_life)
         self._log(f"[route] resuming {rid} from index {next_index}")
         with self._req_lock:
             self._active.add(rid)
         t = threading.Thread(
             target=self._relay, args=(rid, src, writer),
             kwargs={"resume_from": next_index, "wal_line": wal_line,
-                    "as_resume": True},
+                    "as_resume": True, "hop_base": 1},
             name=f"resume-{rid}", daemon=True)
         t.start()
         self._req_threads.append(t)
@@ -1245,7 +1290,8 @@ class Router:
             t = threading.Thread(
                 target=self._relay, args=(o.id, src, writer),
                 kwargs={"resume_from": o.hwm, "wal_line": o.line,
-                        "as_resume": True},
+                        "as_resume": True,
+                        "hop_base": max(1, o.dispatches)},
                 name=f"recover-{o.id}", daemon=True)
             t.start()
             self._req_threads.append(t)

@@ -81,15 +81,22 @@ class RouterJournal(RequestJournal):
     # ------------------------------------------------------------ write
 
     def dispatch(self, rid: str, *, line: str, replica: int,
-                 session: str | None, n: int = 0) -> None:
+                 session: str | None, n: int = 0,
+                 trace: dict | None = None) -> None:
         """One placement decision, durable before the replica sees the
         request. The wire line rides only the first record per request
         (re-dispatches reference it) — the WAL must not grow by the
-        prompt length on every failover."""
-        self._append({"k": "dispatch", "id": rid,
-                      "line": line if n == 0 else None,
-                      "replica": int(replica), "session": session,
-                      "n": int(n)}, sync=True)
+        prompt length on every failover. The hop context rides every
+        record (the stored line stays exactly what the client sent), so
+        a WAL post-mortem can cite the same trace ids the fleet trace
+        renders."""
+        rec = {"k": "dispatch", "id": rid,
+               "line": line if n == 0 else None,
+               "replica": int(replica), "session": session,
+               "n": int(n)}
+        if trace is not None:
+            rec["trace"] = trace
+        self._append(rec, sync=True)
 
     def hwm(self, rid: str, delivered: int) -> None:
         """High-water mark: `delivered` tokens forwarded. Appended

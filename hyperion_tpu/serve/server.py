@@ -87,6 +87,12 @@ def event_record(ev, tok=None) -> dict:
     if ev.finished:
         done: dict = {"id": req.id, "event": "done",
                       "n_tokens": len(req.tokens)}
+        if req.first_token_at is not None and req.submitted_at:
+            # replica-attributed TTFT: the engine-side share of the
+            # client's observed TTFT — loadgen subtracts it to isolate
+            # router overhead (fleet tracing, SERVING.md)
+            done["ttft_ms"] = round(
+                (req.first_token_at - req.submitted_at) * 1000.0, 3)
         if tok is not None:
             eos = getattr(tok, "eos_id", None)
             done["text"] = tok.decode(
@@ -135,6 +141,11 @@ def parse_request_line(line: str, tok=None, defaults: dict | None = None):
             sla_class=str(doc.get("class", "interactive")),
             tenant=(str(doc["tenant"])
                     if doc.get("tenant") is not None else None),
+            # fleet hop context (router-stamped): inherited by every
+            # request_* event this request emits, so a cross-process
+            # trace can join this replica's phases to the dispatch
+            trace=(doc["trace"] if isinstance(doc.get("trace"), dict)
+                   else None),
         )
     except (TypeError, ValueError) as e:
         return {"id": doc.get("id"), "event": "error",

@@ -40,7 +40,8 @@ from hyperion_tpu.serve.loadgen import SERVING_REPORT_KEYS
 # router probe); mirror them here so a rename there orphans the gate
 # loudly
 SERVING_SCALE_KEYS = ("tokens_per_s", "scaleup", "fairness",
-                      "affinity_hit_rate", "duplicate_tokens")
+                      "affinity_hit_rate", "duplicate_tokens",
+                      "router_overhead_p99_ms", "failover_gap_p99_ms")
 
 
 def synthetic_doc() -> dict:

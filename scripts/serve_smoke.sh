@@ -830,4 +830,31 @@ trap - EXIT
 echo "[serve_smoke] OK: router SPOF drill — WAL post-mortem, replica "
 echo "  re-adoption, and client resumes across supervised router lives"
 
+# the crash story leg 11 just produced is exactly what the fleet join
+# exists for: one router stream (two lives), two replica dirs, a
+# mid-request router death, WAL recovery, and answered client resumes.
+# `obs trace --fleet` must render ONE Chrome trace spanning all three
+# processes, with dispatch→admit flow arrows surviving the chaos.
+python -m hyperion_tpu.cli.main obs trace "$WORK/fleet_pm" \
+    --fleet --export "$WORK/fleet_trace.json" \
+    > "$WORK/fleet_trace.out"
+python - "$WORK/fleet_trace.json" <<'PY'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+evs = doc["traceEvents"]
+assert evs, "obs trace --fleet exported an empty Chrome trace"
+pids = {e["pid"] for e in evs if e.get("ph") == "X"}
+assert len(pids) >= 3, (
+    f"fleet trace spans {len(pids)} process track(s), want >=3 "
+    "(router + both replicas)")
+starts = {e["id"] for e in evs if e.get("ph") == "s"}
+ends = {e["id"] for e in evs if e.get("ph") == "f"}
+assert starts & ends, (
+    "fleet trace has no paired dispatch/failover flow arrows")
+print(f"[serve_smoke] OK: fleet trace — {len(evs)} events across "
+      f"{len(pids)} process tracks, {len(starts & ends)} flow arrow(s)")
+PY
+
 echo "[serve_smoke] all legs passed"

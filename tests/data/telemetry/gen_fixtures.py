@@ -339,13 +339,199 @@ def slo():
     t.close()
 
 
+def fleet():
+    """Golden fleet: a router stream plus two `replica_*/` serve
+    streams joined by the wire hop context (`trace={"id","hop",
+    "attempt","router_life"}` — the fields serve/router.py stamps on
+    every dispatch). Three journeys:
+
+        f0 — clean single dispatch to replica 0
+        f1 — mid-stream failover: replica 1 dies after the first
+             token, the router redispatches to replica 0 (the
+             failover_gap component)
+        f2 — client disconnect + resume: the resumed relay admits
+             under the suffixed wire id `f2~r1` (the resume_gap
+             component; the id must fold back to f2)
+
+    All processes share ONE wall clock (same host) but run distinct
+    monotonic bases — exactly the skew `obs trace --fleet` must
+    reconcile. Every request_finished decomposes exactly, so the
+    fleet attribution's sum-to-measured pin has a ground truth."""
+    base = _OUT / "fleet"
+    for sub in ("", "replica_0", "replica_1"):
+        d = base / sub
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "telemetry.jsonl").unlink(missing_ok=True)
+
+    wall = Clock(_WALL0)          # the host clock every process shares
+    rclk, c0, c1 = Clock(100.0), Clock(50.0), Clock(60.0)
+
+    def adv(s: float) -> None:
+        wall.advance(s)
+        for c in (rclk, c0, c1):
+            c.advance(s)
+
+    rt = Tracer(base / "telemetry.jsonl", run="route_fix", proc=0,
+                clock=rclk, wall=wall)
+    rhb = Heartbeat(base / "heartbeat.json", run="route_fix", proc=0,
+                    every=1, clock=rclk, wall=wall)
+    t0 = Tracer(base / "replica_0" / "telemetry.jsonl",
+                run="serve_r0_100", proc=0, clock=c0, wall=wall)
+    h0 = Heartbeat(base / "replica_0" / "heartbeat.json",
+                   run="serve_r0_100", proc=0, every=1, clock=c0,
+                   wall=wall)
+    t1 = Tracer(base / "replica_1" / "telemetry.jsonl",
+                run="serve_r1_100", proc=0, clock=c1, wall=wall)
+    h1 = Heartbeat(base / "replica_1" / "heartbeat.json",
+                   run="serve_r1_100", proc=0, every=1, clock=c1,
+                   wall=wall)
+
+    rhb.pulse(phase="route", ready=2, dispatched=0)
+    for t, h, idx in ((t0, h0, 0), (t1, h1, 1)):
+        t.event("serve_start", slots=2, max_len=64, block_size=8,
+                num_blocks=17, prefix_cache=True)
+        h.pulse(phase="serve", step=0, active=0, queue=0)
+        rt.event("replica_ready", replica=idx)
+        # a couple of engine ticks so the stream has span records
+        for i in range(2):
+            with t.span("serve_tick", step=i) as sp:
+                adv(0.005)
+                sp.set(active=0)
+
+    def leg(t, rid, trace, tick, qw, slot, *, resumed=False,
+            finish=True, decode_s=0.05, replay_wait=0.0):
+        """One replica-side request leg with an exact decomposition."""
+        prefill_s, cw_s = 0.020, 0.002
+        t.event("request_admitted", request=rid, prompt_len=16,
+                max_new_tokens=8, deadline_s=None, trace=trace)
+        adv(qw)
+        t.event("request_scheduled", request=rid, tick=tick,
+                resumed=resumed, queue_wait_s=0.0 if resumed else qw,
+                gate_wait_s=0.0,
+                replay_wait_s=qw if resumed else 0.0)
+        with t.span("serve_prefill", step=tick) as sp:
+            adv(prefill_s)
+            sp.set(request=rid, slot=slot, prompt_len=16,
+                   cached_tokens=0, bucket=16, resumed=resumed)
+        t.event("request_first_token", request=rid, tick=tick,
+                ttft_s=round(qw + prefill_s, 6),
+                queue_wait_s=0.0 if resumed else qw, gate_wait_s=0.0,
+                prefill_s=prefill_s, trace=trace)
+        adv(decode_s)
+        if not finish:
+            return None
+        adv(cw_s + 0.001)
+        e2e = round(qw + prefill_s + decode_s + cw_s + 0.001, 6)
+        t.event("request_finished", request=rid, tick=tick + 1,
+                reason="budget", prompt_len=16, n_tokens=8, preempts=0,
+                e2e_s=e2e, ttft_s=round(qw + prefill_s, 6),
+                queue_wait_s=0.0 if resumed else qw, gate_wait_s=0.0,
+                prefill_s=prefill_s, decode_s=decode_s,
+                preempt_replay_s=qw if resumed else 0.0,
+                client_write_s=cw_s, trace=trace)
+        return e2e
+
+    # ---- f0: the clean path (router_overhead + dispatch_gap + phases)
+    sub = wall.t
+    adv(0.002)                                       # router overhead
+    tr = {"id": "f0", "hop": 0, "attempt": 0, "router_life": 0}
+    rt.event("route_dispatch", request="f0", replica=0, affinity=False,
+             redispatch=0, trace=tr)
+    adv(0.004)                                       # wire: dispatch gap
+    leg(t0, "f0", tr, tick=2, qw=0.05, slot=0)
+    h0.beat(step=3, phase="serve", active=0, queue=0)
+    adv(0.003)                                       # terminal on wire
+    rt.event("route_complete", request="f0", replica=0, status="done",
+             tokens=8, redispatches=0, e2e_s=round(wall.t - sub, 6),
+             trace=tr)
+    rhb.beat(step=1, phase="route", ready=2, dispatched=1)
+
+    # ---- f1: mid-stream failover replica 1 -> replica 0
+    sub = wall.t
+    adv(0.002)
+    tr = {"id": "f1", "hop": 0, "attempt": 0, "router_life": 0}
+    rt.event("route_dispatch", request="f1", replica=1, affinity=False,
+             redispatch=0, trace=tr)
+    adv(0.004)
+    leg(t1, "f1", tr, tick=2, qw=0.06, slot=0, finish=False,
+        decode_s=0.020)                              # dies mid-decode
+    # replica 1's stream ends here; its heartbeat freezes in "serve"
+    t1.flush()
+    t1.close()
+    adv(0.010)                                       # death detected
+    rt.event("route_redispatch", request="f1", from_replica=1,
+             reason="replica_lost", delivered=3, trace=tr)
+    rt.event("replica_ejected", replica=1, reason="stream_lost",
+             restarts=1)
+    adv(0.002)
+    tr = {"id": "f1", "hop": 1, "attempt": 1, "router_life": 0}
+    rt.event("route_dispatch", request="f1", replica=0, affinity=False,
+             redispatch=1, trace=tr)
+    adv(0.300)                    # restart + connect retries: the gap
+    #    — big on purpose: failover_gap must dominate the fixture's
+    #    p99 e2e so the doctor's named fleet incident has a golden case
+    leg(t0, "f1", tr, tick=4, qw=0.03, slot=0)
+    h0.beat(step=5, phase="serve", active=0, queue=0)
+    adv(0.003)
+    rt.event("route_complete", request="f1", replica=0, status="done",
+             tokens=8, redispatches=1, e2e_s=round(wall.t - sub, 6),
+             trace=tr)
+    rhb.beat(step=2, phase="route", ready=1, dispatched=2)
+
+    # ---- f2: client disconnect mid-stream, then a resume relay whose
+    # wire id is the suffixed `f2~r1` — the id-folding case
+    adv(0.002)
+    tr = {"id": "f2", "hop": 0, "attempt": 0, "router_life": 0}
+    rt.event("route_dispatch", request="f2", replica=0, affinity=False,
+             redispatch=0, trace=tr)
+    adv(0.004)
+    leg(t0, "f2", tr, tick=6, qw=0.04, slot=1, finish=False,
+        decode_s=0.030)
+    t0.event("client_disconnected", request="f2", generated=4,
+             trace=tr)
+    rt.event("client_disconnected", request="f2", delivered=4)
+    adv(0.250)                            # the client is away
+    sub = wall.t
+    rt.event("route_resume", request="f2", next_index=4, router_life=0)
+    adv(0.002)
+    tr = {"id": "f2", "hop": 1, "attempt": 0, "router_life": 0}
+    rt.event("route_dispatch", request="f2", replica=0, affinity=True,
+             redispatch=0, trace=tr)
+    adv(0.005)                            # resume admit gap
+    leg(t0, "f2~r1", tr, tick=8, qw=0.015, slot=1, resumed=True,
+        decode_s=0.040)
+    h0.beat(step=9, phase="serve", active=0, queue=0)
+    adv(0.003)
+    rt.event("route_complete", request="f2", replica=0, status="done",
+             tokens=8, redispatches=0, e2e_s=round(wall.t - sub, 6),
+             trace=tr)
+    rhb.beat(step=3, phase="route", ready=1, dispatched=3)
+
+    rt.event("router_end", dispatched=3, completed=3, redispatched=1,
+             resumed=1, rejected=0)
+    rhb.close(phase="done", ready=1, dispatched=3)
+    rt.close()
+    t0.event("serve_end", ticks=10, completed=3, rejected=0,
+             timed_out=0, tokens=24, prefix_hits=0, preempted=0)
+    h0.close(phase="done", tokens=24, active=0, queue=0)
+    t0.close()
+    # replica 1's heartbeat stays frozen mid-"serve": h1 is NOT closed
+    # (the dead-replica evidence `obs doctor` keys off), but its last
+    # beat must exist for the heartbeat contract
+    h1.pulse(phase="serve", step=2, active=1, queue=0)
+
+
 def main() -> int:
     from unittest import mock
 
-    # Heartbeat stamps os.getpid() into heartbeat.json; pin it so
-    # regeneration really is byte-stable (the clocks already are)
-    with mock.patch("os.getpid", return_value=4242):
-        for fn in (healthy, nan, stalled, hung, crashed, serve, slo):
+    # Heartbeat stamps os.getpid() and host_rss_mb() (ru_maxrss — varies
+    # run to run) into heartbeat.json; pin both so regeneration really
+    # is byte-stable (the clocks already are)
+    with mock.patch("os.getpid", return_value=4242), \
+            mock.patch("hyperion_tpu.obs.heartbeat.host_rss_mb",
+                       return_value=20.5):
+        for fn in (healthy, nan, stalled, hung, crashed, serve, slo,
+                   fleet):
             fn()
             print(f"wrote {fn.__name__}/")
     return 0

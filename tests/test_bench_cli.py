@@ -72,7 +72,8 @@ GOOD_SERVING = {"tokens_per_s": 650.0, "ttft_p50_ms": 12.0,
 GOOD_SCALE = {"replicas": 2, "tokens_per_s_1r": 400.0,
               "tokens_per_s": 700.0, "scaleup": 1.75,
               "request_share": {"0": 0.5, "1": 0.5}, "fairness": 1.0,
-              "affinity_hit_rate": 0.6, "completed": 16}
+              "affinity_hit_rate": 0.6, "completed": 16,
+              "router_overhead_p99_ms": 3.5, "failover_gap_p99_ms": 0.0}
 GOOD_MEASUREMENT = {
     "tflops": 150.0, "per_iter_ms": 7.0, "amortized_ms": 7.0,
     "dispatch_overhead_ms": 60.0, "chain_lengths": [16, 48],
@@ -118,6 +119,9 @@ class TestBenchMain:
         assert out["serving"]["tokens_per_s"] == 650.0
         assert out["serving_scale"]["scaleup"] == 1.75
         assert out["serving_scale"]["fairness"] == 1.0
+        # the cross-process keys `obs diff` gates must ride the row
+        assert out["serving_scale"]["router_overhead_p99_ms"] == 3.5
+        assert out["serving_scale"]["failover_gap_p99_ms"] == 0.0
 
     def test_dead_tunnel_emits_failure_with_sanity(self, bench, clock,
                                                    capsys, monkeypatch):

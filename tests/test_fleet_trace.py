@@ -1,0 +1,353 @@
+"""obs trace --fleet: the cross-process join (PR 16).
+
+Driven entirely by the golden fleet fixture
+(tests/data/telemetry/fleet/ — regenerable via gen_fixtures.py): a
+router stream plus two replica streams carrying one clean journey, one
+mid-stream failover, and one client resume under a suffixed wire id.
+Pins the joins, the Perfetto flow-arrow validity of the merged Chrome
+export, the exact-sum fleet attribution, and the partial-evidence
+degradation contract (deleted replica dir -> named evidence gaps,
+never a crash). Host-only: JSONL parsing, zero jit.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import shutil
+from pathlib import Path
+
+import pytest
+
+from hyperion_tpu.obs import fleet_trace, timeline
+
+FLEET = Path(__file__).parent / "data" / "telemetry" / "fleet"
+
+
+@pytest.fixture(scope="module")
+def asm():
+    a = fleet_trace.assemble(FLEET)
+    assert a is not None
+    return a
+
+
+def by_id(asm):
+    return {r["id"]: r for r in asm["requests"]}
+
+
+# ------------------------------------------------- resume-id grammar
+
+
+class TestBaseRequestId:
+    """Satellite: the `{rid}~rN` suffix grammar `submit_resume` mints
+    must fold back to one id, or every resumed request double-counts
+    in attribution and worst-k tables."""
+
+    @pytest.mark.parametrize("rid", ["abc", "w1", "f2", "load-7",
+                                     "9f1c", "a~b"])
+    @pytest.mark.parametrize("n", [1, 2, 17])
+    def test_round_trip(self, rid, n):
+        assert timeline.base_request_id(f"{rid}~r{n}") == rid
+
+    def test_identity_for_unsuffixed(self):
+        for rid in ("abc", "r1", "x~r", "x~ry", "~r"):
+            assert timeline.base_request_id(rid) == rid
+
+    def test_only_the_tail_suffix_strips(self):
+        # one resume of a resume suffixes again — strip one layer at a
+        # time, exactly like the wire ids nest
+        assert timeline.base_request_id("a~r1~r2") == "a~r1"
+        assert timeline.base_request_id(
+            timeline.base_request_id("a~r1~r2")) == "a"
+
+    def test_mid_string_marker_untouched(self):
+        assert timeline.base_request_id("a~r2b") == "a~r2b"
+
+    def test_grammar_matches_minting(self):
+        # the producer's format string, pinned: server.py mints
+        # f"{rid}~r{seq}" with seq >= 1
+        assert re.fullmatch(r".*~r\d+", "x~r1")
+        assert timeline.base_request_id("x" + "~r" + "1") == "x"
+
+
+# ------------------------------------------------------------- joins
+
+
+class TestFleetJoin:
+    def test_discovers_router_and_replicas(self, asm):
+        assert asm["router_runs"] == ["route_fix"]
+        assert sorted(asm["replicas"]) == [0, 1]
+        assert asm["replicas"][0]["runs"] == ["serve_r0_100"]
+
+    def test_three_journeys_joined(self, asm):
+        reqs = by_id(asm)
+        assert sorted(reqs) == ["f0", "f1", "f2"]
+        assert all(r["status"] == "done" for r in reqs.values())
+
+    def test_clean_journey_shape(self, asm):
+        f0 = by_id(asm)["f0"]
+        assert f0["n_dispatches"] == 1
+        assert f0["n_failovers"] == 0 and f0["n_resumes"] == 0
+        # single relay: the value IS the router's measured e2e_s
+        assert f0["e2e_s"] == pytest.approx(0.132, abs=1e-6)
+
+    def test_failover_journey(self, asm):
+        f1 = by_id(asm)["f1"]
+        assert f1["n_dispatches"] == 2
+        assert f1["n_failovers"] == 1
+        c = f1["components_s"]
+        # redispatch -> replacement admit: 2 ms re-placement + 300 ms
+        # restart/connect (the fixture's pinned gap)
+        assert c["failover_gap"] == pytest.approx(0.302, abs=1e-6)
+        # replica phases come from the COMPLETING leg (replica 0)
+        assert c["queue_wait"] == pytest.approx(0.03, abs=1e-6)
+
+    def test_resume_wire_id_folds(self, asm):
+        f2 = by_id(asm)["f2"]
+        assert f2["n_resumes"] == 1
+        # the resumed leg admitted as `f2~r1` — it must NOT appear as
+        # its own journey, and must contribute the resume_gap
+        assert "f2~r1" not in by_id(asm)
+        assert f2["components_s"]["resume_gap"] == pytest.approx(
+            0.007, abs=1e-6)
+
+    def test_no_evidence_gaps_on_the_golden_fixture(self, asm):
+        assert asm["evidence_gaps"] == []
+
+
+# ------------------------------------------------- exact-sum property
+
+
+class TestAttribution:
+    def test_components_sum_exactly_to_measured_value(self, asm):
+        """THE tier-1 pin: every fleet attribution row's components +
+        other equal the client-observed value — nothing invented,
+        nothing dropped between processes."""
+        att = fleet_trace.attribution(asm)
+        assert att["completed"] == 3
+        assert att["rows"], "fixture must yield attribution rows"
+        for row in att["rows"]:
+            total = sum(row["components_ms"].values()) + row["other_ms"]
+            assert total == pytest.approx(row["value_ms"], abs=0.005), \
+                (row["metric"], row["q"])
+
+    def test_e2e_vocabulary_is_the_fleet_superset(self, asm):
+        (row,) = [r for r in fleet_trace.attribution(asm)["rows"]
+                  if r["metric"] == "e2e" and r["q"] == 99]
+        assert set(row["components_ms"]) == set(fleet_trace.FLEET_PHASES)
+
+    def test_p99_e2e_dominated_by_failover_gap(self, asm):
+        (row,) = [r for r in fleet_trace.attribution(asm)["rows"]
+                  if r["metric"] == "e2e" and r["q"] == 99]
+        assert row["dominant"] == "failover_gap"
+        assert row["dominant_frac"] >= fleet_trace.TAIL_DOMINANT_FRAC
+
+    def test_incident_names_the_slow_restart(self, asm):
+        rows = fleet_trace.attribution(asm)["rows"]
+        incidents = fleet_trace.tail_incidents(rows)
+        assert any("failover_gap" in m and "replica restarts too slow"
+                   in m for m in incidents)
+
+    def test_ttft_decomposes_with_cross_process_components(self, asm):
+        f0 = by_id(asm)["f0"]
+        tc = f0["ttft_components_s"]
+        assert tc["router_overhead"] == pytest.approx(0.002, abs=1e-6)
+        assert tc["dispatch_gap"] == pytest.approx(0.004, abs=1e-6)
+        # ttft value closes exactly over its components
+        assert f0["ttft_s"] == pytest.approx(
+            sum(tc.values()), abs=1e-6)
+
+
+# ------------------------------------------------------ Chrome export
+
+
+class TestChromeExport:
+    @pytest.fixture(scope="class")
+    def trace(self):
+        return fleet_trace.chrome_fleet_trace(fleet_trace.assemble(FLEET))
+
+    def test_one_trace_spans_three_processes(self, trace):
+        ev = trace["traceEvents"]
+        assert {e["pid"] for e in ev} == {0, 1, 2}
+        names = {(e["pid"], e["args"]["name"]) for e in ev
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert (0, "hyperion route") in names
+        assert (1, "hyperion serve replica_0") in names
+        assert (2, "hyperion serve replica_1") in names
+
+    def test_flow_arrows_pair_and_cross_processes(self, trace):
+        """Perfetto renders an arrow only for a well-formed s/f pair:
+        same id + cat, the finish side bound to the enclosing slice
+        ("bp": "e"). Every dispatch/failover/resume edge must produce
+        one, and it must actually cross a process boundary."""
+        ev = trace["traceEvents"]
+        starts = {e["id"]: e for e in ev if e["ph"] == "s"}
+        finishes = {e["id"]: e for e in ev if e["ph"] == "f"}
+        assert sorted(starts) == sorted(finishes)
+        assert len(starts) == 5  # f0: 1 dispatch; f1: 2; f2: 2
+        kinds = []
+        for fid, s in starts.items():
+            f = finishes[fid]
+            assert s["cat"] == f["cat"] == "fleet"
+            assert s["name"] == f["name"]
+            assert f["bp"] == "e"
+            assert s["pid"] == 0 and f["pid"] != 0   # router -> replica
+            assert f["ts"] >= s["ts"]                # time flows forward
+            kinds.append(s["name"])
+        assert sorted(set(kinds)) == ["dispatch", "failover", "resume"]
+
+    def test_replica_segments_share_the_wall_axis(self, trace):
+        ev = trace["traceEvents"]
+        assert all(e["ts"] >= 0 for e in ev if "ts" in e)
+        # the failover's replacement prefill happens AFTER the original
+        # dispatch on the merged axis — mono bases differ per process,
+        # so only a correct wall conversion orders them
+        x = [e for e in ev if e["ph"] == "X"]
+        assert any(e["pid"] in (1, 2) for e in x)
+        assert any(e["pid"] == 0 and e["name"] == "relay" for e in x)
+
+
+# ------------------------------------------------------- degradation
+
+
+class TestPartialEvidence:
+    def test_deleted_replica_dir_degrades_with_named_gaps(self, tmp_path):
+        base = tmp_path / "fleet"
+        shutil.copytree(FLEET, base)
+        shutil.rmtree(base / "replica_0")
+        asm = fleet_trace.assemble(base)
+        assert asm is not None
+        # all journeys still render from router-side evidence
+        assert sorted(by_id(asm)) == ["f0", "f1", "f2"]
+        gaps = "\n".join(asm["evidence_gaps"])
+        assert "no matching request_admitted" in gaps
+        assert "replica 0" in gaps
+        # and the whole pipeline stays alive on the partial evidence
+        att = fleet_trace.attribution(asm)
+        trace = fleet_trace.chrome_fleet_trace(asm)
+        assert att["rows"] and trace["traceEvents"]
+
+    def test_missing_replica_stream_named(self, tmp_path):
+        base = tmp_path / "fleet"
+        shutil.copytree(FLEET, base)
+        (base / "replica_1" / "telemetry.jsonl").unlink()
+        asm = fleet_trace.assemble(base)
+        assert any("replica_1" in g and "no telemetry.jsonl" in g
+                   for g in asm["evidence_gaps"])
+
+    def test_foreign_run_heartbeat_named(self, tmp_path):
+        base = tmp_path / "fleet"
+        shutil.copytree(FLEET, base)
+        hb = base / "replica_0" / "heartbeat.json"
+        doc = json.loads(hb.read_text())
+        doc["run"] = "serve_r0_SOMEONE_ELSE"
+        hb.write_text(json.dumps(doc))
+        asm = fleet_trace.assemble(base)
+        assert any("foreign run" in g and "replica_0" in g
+                   for g in asm["evidence_gaps"])
+
+    def test_torn_router_tail_survives(self, tmp_path):
+        base = tmp_path / "fleet"
+        shutil.copytree(FLEET, base)
+        with (base / "telemetry.jsonl").open("a") as f:
+            f.write('{"v":1,"kind":"event","name":"route_disp')
+        asm = fleet_trace.assemble(base)
+        assert sorted(by_id(asm)) == ["f0", "f1", "f2"]
+
+    def test_no_router_stream_exits_2(self, tmp_path, capsys):
+        rc = timeline.main([str(tmp_path), "--fleet", "--export",
+                            "none"])
+        assert rc == 2
+        assert "no router telemetry" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------- CLI
+
+
+class TestCli:
+    def test_obs_trace_fleet_end_to_end(self, tmp_path, capsys):
+        out = tmp_path / "fleet.json"
+        rc = timeline.main([str(FLEET), "--fleet", "--export",
+                            str(out)])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "Fleet trace" in text
+        assert "failover_gap" in text
+        assert "incident" in text
+        t = json.loads(out.read_text())
+        assert {e["pid"] for e in t["traceEvents"]} == {0, 1, 2}
+
+    def test_json_mode_carries_the_join(self, tmp_path, capsys):
+        rc = timeline.main([str(FLEET), "--fleet", "--json",
+                            "--export", "none"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["export"] is None
+        assert len(doc["fleet"]["requests"]) == 3
+        assert doc["incidents"]
+        for row in doc["attribution"]["rows"]:
+            total = sum(row["components_ms"].values()) + row["other_ms"]
+            assert total == pytest.approx(row["value_ms"], abs=0.005)
+
+    def test_cli_main_dispatches_fleet_flag(self, tmp_path, capsys):
+        from hyperion_tpu.cli.main import main as cli_main
+
+        out = tmp_path / "t.json"
+        rc = cli_main(["obs", "trace", str(FLEET), "--fleet",
+                       "--export", str(out)])
+        assert rc == 0
+        assert out.exists()
+
+
+# ----------------------------------------------- doctor integration
+
+
+class TestDoctorFleetTrace:
+    def test_doctor_names_the_cross_process_incident(self):
+        from hyperion_tpu.obs import doctor
+
+        d = doctor.diagnose(FLEET)
+        assert d["verdict"] == "healthy"
+        assert any("failover_gap" in m and "replica restarts" in m
+                   for m in d["fleet_trace_incidents"])
+        assert "fleet trace:" in d["reason"]
+        assert any(r["q"] == 99 for r in d["fleet_trace"])
+
+    def test_doctor_survives_partial_fleet(self, tmp_path):
+        from hyperion_tpu.obs import doctor
+
+        base = tmp_path / "fleet"
+        shutil.copytree(FLEET, base)
+        shutil.rmtree(base / "replica_0")
+        d = doctor.diagnose(base)  # must not raise
+        assert d["verdict"] in ("healthy", "running", "crashed",
+                                "stalled", "hung", "failed")
+
+
+class TestFixtureRegeneration:
+    """The golden fleet fixture is byte-stable: rerunning the generator
+    (fake clocks, pinned pid/rss) reproduces the committed files
+    exactly, so fixture edits are always deliberate diffs."""
+
+    def test_fleet_fixture_regenerates_byte_identical(self, tmp_path,
+                                                      monkeypatch):
+        import importlib.util
+        from unittest import mock
+
+        gen_path = FLEET.parent / "gen_fixtures.py"
+        spec = importlib.util.spec_from_file_location("gen_fix", gen_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        monkeypatch.setattr(mod, "_OUT", tmp_path)
+        with mock.patch("os.getpid", return_value=4242), \
+                mock.patch("hyperion_tpu.obs.heartbeat.host_rss_mb",
+                           return_value=20.5):
+            mod.fleet()
+
+        for rel in ("telemetry.jsonl", "heartbeat.json",
+                    "replica_0/telemetry.jsonl", "replica_0/heartbeat.json",
+                    "replica_1/telemetry.jsonl", "replica_1/heartbeat.json"):
+            fresh = (tmp_path / "fleet" / rel).read_bytes()
+            committed = (FLEET / rel).read_bytes()
+            assert fresh == committed, f"fleet/{rel} drifted from generator"

@@ -25,6 +25,11 @@ REPO = Path(__file__).resolve().parents[1]
 
 ALL_FIXTURES = ("healthy", "nan", "stalled", "hung", "crashed", "serve",
                 "slo")
+# the fleet fixture is three streams in one layout (router + two
+# replicas); each joins the record contract individually — the fleet
+# join (tests/test_fleet_trace.py) only works if every constituent
+# stream honors the same envelope the single-process tools read
+FLEET_FIXTURES = ("fleet", "fleet/replica_0", "fleet/replica_1")
 
 
 class FakeClock:
@@ -538,7 +543,7 @@ class TestRecordContract:
         assert out, f"fixture {name} unreadable"
         return out
 
-    @pytest.mark.parametrize("name", ALL_FIXTURES)
+    @pytest.mark.parametrize("name", ALL_FIXTURES + FLEET_FIXTURES)
     def test_every_record_carries_envelope(self, name):
         for r in self.records(name):
             assert r["v"] == 1
@@ -550,7 +555,9 @@ class TestRecordContract:
             assert isinstance(r["t_mono"], (int, float))
             assert r["step"] is None or isinstance(r["step"], int)
 
-    @pytest.mark.parametrize("name", ALL_FIXTURES)
+    # the fleet ROUTER stream is events-only (relays are threads, not
+    # ticks) — only its replica streams join the span contract
+    @pytest.mark.parametrize("name", ALL_FIXTURES + FLEET_FIXTURES[1:])
     def test_span_records(self, name):
         spans = [r for r in self.records(name) if r["kind"] == "span"]
         assert spans
@@ -577,7 +584,7 @@ class TestRecordContract:
         assert ev["fatal"] is True
         assert ev["action"] in ("warn", "checkpoint", "abort")
 
-    @pytest.mark.parametrize("name", ALL_FIXTURES)
+    @pytest.mark.parametrize("name", ALL_FIXTURES + FLEET_FIXTURES)
     def test_heartbeat_contract(self, name):
         hb = read_heartbeat(FIXTURES / name / "heartbeat.json")
         assert hb is not None
@@ -588,7 +595,7 @@ class TestRecordContract:
                            ("t_mono", (int, float)), ("beats", int)):
             assert isinstance(hb[field], typ), (name, field)
 
-    @pytest.mark.parametrize("name", ALL_FIXTURES)
+    @pytest.mark.parametrize("name", ALL_FIXTURES + FLEET_FIXTURES)
     def test_heartbeat_reader_tolerates_unknown_fields(self, name, tmp_path):
         """Live-plane payload growth (alerts, occupancy, whatever comes
         next) must never break an older reader: read_heartbeat returns

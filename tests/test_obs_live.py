@@ -860,6 +860,51 @@ class TestDiffGatesGuard:
         assert "serve_never_emitted" in capsys.readouterr().err
 
 
+class TestEventVocabGuard:
+    """scripts/check_event_vocab.py — an event the producers emit but
+    no consumer names has silently vanished from every waterfall and
+    diagnosis; the guard makes the rename loud."""
+
+    def _guard(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "check_event_vocab",
+            Path(__file__).parent.parent / "scripts"
+            / "check_event_vocab.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_current_events_all_consumed(self):
+        assert self._guard().main([]) == 0
+
+    def test_orphaned_event_fails(self, tmp_path, monkeypatch, capsys):
+        mod = self._guard()
+        # a producer dir with an event no consumer has ever heard of
+        prod = tmp_path / "serve"
+        prod.mkdir()
+        (prod / "thing.py").write_text(
+            'tracer.event(\n    "serve_event_nobody_consumes", x=1)\n')
+        monkeypatch.setattr(mod, "PRODUCER_DIR", str(prod))
+        assert mod.main([]) == 1
+        err = capsys.readouterr().err
+        assert "serve_event_nobody_consumes" in err
+        assert "thing.py:1" in err
+
+    def test_wrapped_name_literal_is_found(self, tmp_path, monkeypatch):
+        """Call sites that wrap the name onto the next line (the
+        dominant style under serve/) must still be scanned."""
+        mod = self._guard()
+        prod = tmp_path / "serve"
+        prod.mkdir()
+        (prod / "w.py").write_text(
+            'self.tracer.event(\n'
+            '    "route_dispatch", request=rid)\n')
+        monkeypatch.setattr(mod, "PRODUCER_DIR", str(prod))
+        assert mod.main([]) == 0
+
+
 class TestExpositionControl:
     def test_control_round_trip_and_bare_clients(self, tmp_path):
         from hyperion_tpu.obs.export import request_control
