@@ -534,6 +534,35 @@ def _child_serving_scale() -> None:
     }))
 
 
+def _child_fleet_sim() -> None:
+    """Fleet flight-simulator probe (serve/simulate.py): the pinned
+    `herd` and `failover` scenarios replayed on the discrete-event
+    harness — the REAL router dispatch/steering/brownout/failover
+    policy over hundreds of virtual replicas, no jits, seconds of
+    wall clock. Reports the DIFF_GATED subset under canonical
+    sim_<scenario>_<key> names so `obs diff` gates policy regressions
+    (a worse herd completion rate, a longer failover gap, ANY
+    duplicate delivery) the same way it gates engine throughput.
+    Chip-free by construction, so the row rides success AND failure
+    lines."""
+    import tempfile
+    from pathlib import Path
+
+    from hyperion_tpu.serve.simulate import (DIFF_GATED, diff_key,
+                                             run_scenario)
+
+    work = Path(tempfile.mkdtemp(prefix="fleet_sim_"))
+    row: dict = {}
+    for name in sorted(DIFF_GATED):
+        res = run_scenario(name, out=str(work / name))
+        rep = res["report"]
+        for key in DIFF_GATED[name]:
+            row[diff_key(name, key)] = rep.get(key)
+        row[f"sim_{name}_ok"] = bool(res["ok"])
+        row[f"sim_{name}_wall_s"] = res["wall_s"]
+    print(json.dumps(row))
+
+
 def _child_cpu_sanity() -> None:
     """The SAME measurement harness on the host CPU backend at small N.
     When the live value is 0.0 this row proves the harness itself works
@@ -713,6 +742,32 @@ def _add_serving_scale(out: dict, hb, tracer, remaining) -> None:
                  affinity_hit_rate=(scl or {}).get("affinity_hit_rate"))
 
 
+def _add_fleet_sim(out: dict, hb, tracer, remaining) -> None:
+    """Attach the flight-simulator probe row (`--child-fleet-sim`):
+    pinned herd + failover scenarios on the discrete-event harness.
+    No jits and no subprocesses-of-subprocesses, so it is the cheapest
+    serving row — it rides success AND failure lines ahead of the
+    expensive socket probes."""
+    if remaining() < 45:
+        out["fleet_sim"] = {"error": "deadline reached; skipped"}
+        tracer.event("deadline", where="fleet_sim",
+                     remaining_s=round(remaining(), 1))
+        return
+    hb.pulse(phase="fleet_sim")
+    sim, serr = _run_child(
+        "--child-fleet-sim", int(min(120, remaining() - 15)),
+        env={"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+    )
+    out["fleet_sim"] = sim if sim is not None else {"error": serr}
+    tracer.event("fleet_sim", ok=sim is not None, error=serr or None,
+                 herd_ok=(sim or {}).get("sim_herd_ok"),
+                 failover_ok=(sim or {}).get("sim_failover_ok"),
+                 herd_completed_rate=(sim or {}).get(
+                     "sim_herd_completed_rate"),
+                 failover_gap_p99_ms=(sim or {}).get(
+                     "sim_failover_gap_p99_ms"))
+
+
 def main() -> None:
     import time
 
@@ -886,6 +941,7 @@ def main() -> None:
                 "capture, NOT a live number"
             )
         _add_input_pipeline(out, hb, tracer, remaining)
+        _add_fleet_sim(out, hb, tracer, remaining)
         _add_serving(out, hb, tracer, remaining)
         _add_serving_scale(out, hb, tracer, remaining)
         tracer.event("publish", value=0.0, failed=True, error=err)
@@ -942,6 +998,7 @@ def main() -> None:
     else:
         out["extra"] = {"error": "deadline reached; skipped"}
     _add_input_pipeline(out, hb, tracer, remaining)
+    _add_fleet_sim(out, hb, tracer, remaining)
     _add_serving(out, hb, tracer, remaining)
     _add_serving_scale(out, hb, tracer, remaining)
     tracer.event("publish", value=out["value"], plausible=plausible,
@@ -964,6 +1021,8 @@ if __name__ == "__main__":
         _child_serving()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child-serving-scale":
         _child_serving_scale()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--child-fleet-sim":
+        _child_fleet_sim()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child-cpu-sanity":
         _child_cpu_sanity()
     else:

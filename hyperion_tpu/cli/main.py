@@ -323,6 +323,14 @@ def main(argv=None) -> int:
         from hyperion_tpu.serve.server import main as serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "simulate":
+        # fleet flight simulator (`hyperion simulate herd --replicas
+        # 200` — serve/simulate.py plays a scenario over the real
+        # routing/queueing policy code on a virtual clock; no devices,
+        # no jax, no subprocesses)
+        from hyperion_tpu.serve.simulate import main as sim_main
+
+        return sim_main(argv[1:])
     if argv and argv[0] == "route":
         # replica-tier router (`hyperion route --replicas N --ckpt ...`
         # — serve/router.py owns its arg surface; the router process

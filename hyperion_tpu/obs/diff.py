@@ -119,6 +119,21 @@ METRICS: dict[str, str] = {
     # per-process gate above, so they get their own
     "serve_router_overhead_p99_ms": "lower",
     "serve_failover_gap_p99_ms": "lower",
+    # fleet flight simulator (serve/simulate.py via the bench fleet_sim
+    # probe): pinned herd + failover scenarios replayed at every bench
+    # run. These gate POLICY — a dispatch, steering, brownout, or
+    # failover change that degrades what the scenario asserts shows up
+    # here even when every per-process engine gate above stays flat.
+    "sim_herd_shed_rate": "lower",
+    "sim_herd_completed_rate": "higher",
+    "sim_herd_interactive_ttft_p99_ms": "lower",
+    "sim_herd_alerts_raised": "lower",
+    "sim_herd_duplicate_tokens": "lower",
+    "sim_failover_completed_rate": "higher",
+    "sim_failover_interactive_ttft_p99_ms": "lower",
+    "sim_failover_gap_p99_ms": "lower",
+    "sim_failover_steer_reversals": "lower",
+    "sim_failover_duplicate_tokens": "lower",
 }
 
 # metrics whose healthy value is exactly zero: the percent-threshold
@@ -132,7 +147,12 @@ ZERO_PINNED = frozenset({"serve_recompiles",
                          "serve_batch_shed_rate",
                          # exactly-once delivery: the ONLY healthy
                          # duplicate count is 0
-                         "serve_duplicate_tokens"})
+                         "serve_duplicate_tokens",
+                         # the simulated fleet makes the same promise —
+                         # a duplicate under virtual failover is the
+                         # same dedup bug, caught cheaper
+                         "sim_herd_duplicate_tokens",
+                         "sim_failover_duplicate_tokens"})
 
 
 def _num(v) -> float | None:
@@ -230,6 +250,17 @@ def normalize(doc: dict) -> dict[str, float]:
                               ("failover_gap_p99_ms",
                                "serve_failover_gap_p99_ms")):
                 v = _num(scale.get(src))
+                if v is not None:
+                    out[name] = v
+        # bench fleet_sim probe (serve/simulate.py): the child already
+        # stamps canonical diff names (sim_<scenario>_<key>), so the
+        # branch only has to keep the ones the gate vocabulary knows
+        fsim = doc.get("fleet_sim")
+        if isinstance(fsim, dict):
+            for name in METRICS:
+                if not name.startswith("sim_"):
+                    continue
+                v = _num(fsim.get(name))
                 if v is not None:
                     out[name] = v
     # trainer *_summary.json {"step_ms": ..., "peak_hbm_mb": ...}

@@ -749,6 +749,45 @@ def diagnose(
                                       "failed", "crashed", "hung"):
         reason += "; router actions: " + "; ".join(router_actions)
 
+    # Flight-simulator runs (serve/simulate.py): the discrete-event
+    # harness stamps its scenario header and assertion verdict into the
+    # same stream, so a sim run diagnoses like a live one — plus one
+    # extra row saying whether the scenario's obs-plane assertions
+    # held. A failed sim check is a POLICY regression, not an outage.
+    sim: dict | None = None
+    sim_hdr = next((e for e in reversed(events)
+                    if e.get("name") == "sim_scenario"), None)
+    sim_rep = next((e for e in reversed(events)
+                    if e.get("name") == "sim_report"), None)
+    if sim_hdr or sim_rep:
+        sim = {
+            "scenario": (sim_hdr or sim_rep).get("scenario"),
+            "replicas": (sim_hdr or {}).get("replicas"),
+            "requests": (sim_hdr or {}).get("requests"),
+            "duration_s": (sim_hdr or {}).get("duration_s"),
+            "seed": (sim_hdr or {}).get("seed"),
+            "ok": (sim_rep or {}).get("ok"),
+            "checks": (sim_rep or {}).get("checks"),
+            "failed": (sim_rep or {}).get("failed"),
+            "failed_checks": (sim_rep or {}).get("failed_checks") or [],
+            "report": (sim_rep or {}).get("report"),
+        }
+        if sim_rep is None:
+            sim["incident"] = (
+                f"simulation '{sim['scenario']}' emitted no verdict — "
+                "the harness died mid-scenario")
+        elif not sim["ok"]:
+            sim["incident"] = (
+                f"simulation '{sim['scenario']}' failed "
+                f"{sim['failed']}/{sim['checks']} assertion(s): "
+                + "; ".join(sim["failed_checks"]))
+        else:
+            sim["incident"] = None
+        if sim["incident"] and verdict in (
+                "healthy", "running", "stalled", "failed", "crashed",
+                "hung"):
+            reason += "; sim: " + sim["incident"]
+
     # Tail-attribution incidents (obs/timeline.py): the request-scoped
     # trace says WHERE the p99 went, so the doctor can name the FIX —
     # "raise --slots" and "raise --num-blocks" are different knobs a
@@ -927,6 +966,9 @@ def diagnose(
         "tenants": tenants,
         "tenant_incidents": tenant_incidents,
         "router_actions": router_actions,
+        # flight simulator (serve/simulate.py): scenario header and
+        # assertion verdict from a discrete-event fleet run
+        "sim": sim,
         "cache_pressure": cache_pressure,
         "spec_incidents": spec_issues,
         "overload": overload,
@@ -1136,6 +1178,23 @@ def render_markdown(d: dict) -> str:
             f"rejected {row['rejected']}{flag} |")
     for act in d.get("router_actions") or []:
         lines.append(f"| router action | {act} |")
+    sim = d.get("sim")
+    if sim:
+        shape = (f"{_fmt(sim.get('requests'))} req / "
+                 f"{_fmt(sim.get('replicas'))} replicas / "
+                 f"{_fmt(sim.get('duration_s'))} s, "
+                 f"seed {_fmt(sim.get('seed'))}")
+        if sim.get("ok") is None:
+            verdict_s = "**no verdict** — harness died mid-scenario"
+        elif sim["ok"]:
+            verdict_s = f"all {sim['checks']} assertion(s) held"
+        else:
+            verdict_s = (f"**{sim['failed']}/{sim['checks']} "
+                         f"assertion(s) FAILED**: "
+                         + "; ".join(sim.get("failed_checks") or ()))
+        lines.append(
+            f"| simulation `{sim['scenario']}` | {shape} — "
+            f"{verdict_s} |")
     for row in d.get("fleet_trace") or []:
         if row.get("q") != 99:
             continue

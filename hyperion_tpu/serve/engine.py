@@ -68,6 +68,8 @@ import dataclasses
 import itertools
 import sys
 import time
+
+from hyperion_tpu.utils.clock import SYSTEM as _CLOCK
 from typing import Any, Callable
 
 import jax
@@ -733,10 +735,10 @@ class Engine:
             # upload only when the table or slot liveness changed —
             # steady-state decode re-uses the device copies, so a tick
             # costs zero host->device traffic
-            t0u = time.monotonic()
+            t0u = _CLOCK()
             self._bt_dev = (jnp.asarray(self._bt),
                             jnp.asarray(self._live_mask()))
-            self._bt_upload_s += time.monotonic() - t0u
+            self._bt_upload_s += _CLOCK() - t0u
         self._cache, self._state, toks, fins = self._tick_jit(
             self.model, self.cfg.eos_id, self.cfg.pad_id,
             self.variables, self._cache, self._state, *self._bt_dev)
@@ -758,10 +760,10 @@ class Engine:
 
     def _spec_tick_device(self, drafts: np.ndarray):
         if self._bt_dev is None:
-            t0u = time.monotonic()
+            t0u = _CLOCK()
             self._bt_dev = (jnp.asarray(self._bt),
                             jnp.asarray(self._live_mask()))
-            self._bt_upload_s += time.monotonic() - t0u
+            self._bt_upload_s += _CLOCK() - t0u
         self._cache, self._state, out, cnt, acc, fins = self._spec_jit(
             self.model, self.cfg.eos_id, self.cfg.pad_id,
             self.variables, self._cache, self._state, *self._bt_dev,
@@ -929,7 +931,7 @@ class Engine:
         seq.n_filled = P
         if self.prefix is not None:
             self.prefix.insert(prompt, seq.blocks)
-        now = time.monotonic()
+        now = _CLOCK()
         req.prefilled_at = now
         if resumed:
             # a resume re-prefills prompt + generated: pure replay cost
@@ -977,7 +979,7 @@ class Engine:
         P = int(prompt.shape[0])
         pos = ck["pos"]
         if P - pos > C:
-            t0 = time.monotonic()
+            t0 = _CLOCK()
             self._cache = self._chunk_jit(
                 self.model, self.variables, self._cache,
                 jnp.asarray(np.asarray(prompt[pos:pos + C],
@@ -986,7 +988,7 @@ class Engine:
             # fence: the segment's wall time must land in THIS step's
             # chunk segment, not smear into the next device call
             jax.block_until_ready(self._cache)
-            dt = time.monotonic() - t0
+            dt = _CLOCK() - t0
             if ck["resumed"]:
                 req.replay_s += dt
             else:
@@ -1011,7 +1013,7 @@ class Engine:
         seq.n_filled = P
         if self.prefix is not None:
             self.prefix.insert(prompt, seq.blocks)
-        now = time.monotonic()
+        now = _CLOCK()
         req.prefilled_at = now
         if resumed:
             req.replay_s += sp.dur_s or 0.0
@@ -1066,7 +1068,7 @@ class Engine:
         flag — the request is STILL a resume and its next wait must
         bank as replay, not FIFO queue_wait."""
         popped = (req.admitted_at if req.admitted_at is not None
-                  else time.monotonic())
+                  else _CLOCK())
         wait = max(0.0, popped - req.enqueued_at)
         gate = 0.0
         if req.gate_blocked_at is not None:
@@ -1168,7 +1170,7 @@ class Engine:
         # can never re-compute — hence never re-deliver — it. The
         # client stream stays duplicate-free across kills.
         if self.journal is not None and req._journaled:
-            jt0 = time.monotonic()
+            jt0 = _CLOCK()
             if ev.kind == "token" and ev.token is not None:
                 self.journal.token(req.id, ev.token)
             if ev.finished:
@@ -1181,14 +1183,14 @@ class Engine:
                 # same reasoning): reject writes on front-end reader
                 # threads must not pollute the step profiler's journal
                 # segment
-                self._journal_s += time.monotonic() - jt0
+                self._journal_s += _CLOCK() - jt0
             self._journal_guard()
         if self.chaos is not None:
             # the request rides along so tenant-targeted client chaos
             # (slowloris@tenant=...) can pick its victim
             self.chaos.on_client(self._tick_no, req)
         if req.sink is not None:
-            t0 = time.monotonic()
+            t0 = _CLOCK()
             try:
                 req.sink(ev)
             except Exception:  # noqa: BLE001
@@ -1203,7 +1205,7 @@ class Engine:
             # charge transport time to the REQUEST (a slow client must
             # show up in its own tail attribution, not vanish into the
             # decode gap it inflates)
-            dt = time.monotonic() - t0
+            dt = _CLOCK() - t0
             req.client_write_s += dt
             if ev.kind in ("token", "timed_out"):
                 # token AND timeout emissions happen only on the engine
@@ -1224,7 +1226,7 @@ class Engine:
             # would be charged to client_write yet fall outside e2e and
             # the phases could sum past the total — and every reporter
             # (request_finished event, loadgen e2e) reads this one stamp
-            req.finished_at = time.monotonic()
+            req.finished_at = _CLOCK()
             req.done.set()
 
     def _on_finished(self, req) -> None:
@@ -1235,7 +1237,7 @@ class Engine:
         sink write — the single terminal clock edge every reporter
         (this event, the histograms, loadgen) agrees on."""
         now = req.finished_at if req.finished_at is not None \
-            else time.monotonic()
+            else _CLOCK()
         self.metrics.on_finish(req, now)
         reason = ("eos" if self.cfg.eos_id is not None and req.tokens
                   and req.tokens[-1] == self.cfg.eos_id else "budget")
@@ -1342,7 +1344,7 @@ class Engine:
         if self._draining:
             return
         self._draining = True
-        self._drain_deadline = time.monotonic() + max(0.0, timeout_s)
+        self._drain_deadline = _CLOCK() + max(0.0, timeout_s)
         self.queue.close(REJECT_DRAINING)
         self.tracer.event("serve_draining", tick=self._tick_no,
                           active=self.n_active, queue=len(self.queue),
@@ -1352,7 +1354,7 @@ class Engine:
 
     def drain_expired(self) -> bool:
         return (self._draining and self._drain_deadline is not None
-                and time.monotonic() > self._drain_deadline)
+                and _CLOCK() > self._drain_deadline)
 
     def replay_pending(self, sink=None, *,
                        max_replays: int = MAX_REPLAYS_DEFAULT) -> dict:
@@ -1568,7 +1570,7 @@ class Engine:
         all active slots — one token each, or 1..spec_k+1 under the
         speculative tick — and route emissions."""
         emissions: list[TokenEvent] = []
-        now = time.monotonic()
+        now = _CLOCK()
         # host-tick profiler (obs/tickprof.py): stamp each segment of
         # this step into `seg` — pure perf-counter arithmetic, no device
         # interaction. Journal/sink time is accumulated inside _emit
@@ -1627,7 +1629,7 @@ class Engine:
                 self._emit(ev)
                 emissions.append(ev)
 
-        t_seg = time.monotonic()
+        t_seg = _CLOCK()
         free = [s for s, r in enumerate(self._slots) if r is None]
         if free:
             admit, expired = self.queue.pop_ready(
@@ -1653,8 +1655,8 @@ class Engine:
                 victim = max(batch_live,
                              key=lambda t: self._seqs[t].order)
                 self._preempt(victim, reason="interactive_gate")
-        seg["queue_pop"] = time.monotonic() - t_seg
-        t_seg = time.monotonic()
+        seg["queue_pop"] = _CLOCK() - t_seg
+        t_seg = _CLOCK()
         j_mark, s_mark = self._journal_s, self._sink_s
         for req in expired:
             self.metrics.on_timeout()
@@ -1709,21 +1711,21 @@ class Engine:
                 self._on_finished(req)
         # admit covers expiry + admission + their prefill calls, net of
         # journal/sink writes those paths perform
-        seg["admit"] = max(0.0, (time.monotonic() - t_seg)
+        seg["admit"] = max(0.0, (_CLOCK() - t_seg)
                            - (self._journal_s - j_mark)
                            - (self._sink_s - s_mark))
 
         # one chunked-prefill segment per step, interleaved with the
         # decode tick below — the whole point: co-running slots tick
         # every step while a long prompt fills in bounded bites
-        t_seg = time.monotonic()
+        t_seg = _CLOCK()
         j_mark, s_mark = self._journal_s, self._sink_s
         for ev in self._advance_chunks():
             self._emit(ev)
             emissions.append(ev)
             if ev.finished:
                 self._on_finished(ev.request)
-        seg["chunk"] = max(0.0, (time.monotonic() - t_seg)
+        seg["chunk"] = max(0.0, (_CLOCK() - t_seg)
                            - (self._journal_s - j_mark)
                            - (self._sink_s - s_mark))
 
@@ -1735,17 +1737,17 @@ class Engine:
                 self.chaos.on_tick(self._tick_no)
             spec = self._spec
             cnts = accs = None
-            t_seg = time.monotonic()
+            t_seg = _CLOCK()
             drafts = self._collect_drafts() if spec else None
-            seg["draft"] = time.monotonic() - t_seg
+            seg["draft"] = _CLOCK() - t_seg
             u_mark = self._bt_upload_s
             with self.tracer.span("serve_tick", step=self._tick_no) as sp:
-                t0 = time.monotonic()
+                t0 = _CLOCK()
                 if spec:
                     toks, cnts, accs, fins = self._spec_tick_device(drafts)
                 else:
                     toks, fins = self._tick_device()
-                dur = time.monotonic() - t0
+                dur = _CLOCK() - t0
                 sp.set(active=self.n_active)
             # the device call's wall splits into the host->device table
             # upload (when the table went stale) and dispatch+wait
@@ -1753,7 +1755,7 @@ class Engine:
             seg["device"] = max(0.0, dur - seg["bt_upload"])
             emitted = 0
             slot_ticks = 0
-            tnow = time.monotonic()
+            tnow = _CLOCK()
             j_mark, s_mark = self._journal_s, self._sink_s
             for s, req in enumerate(self._slots):
                 if req is None or s in self._chunking:
@@ -1801,7 +1803,7 @@ class Engine:
                     self._free_slot(s)
             # accept host path: token routing + gap netting, minus the
             # journal/sink writes _emit charged to their own segments
-            seg["accept"] = max(0.0, (time.monotonic() - tnow)
+            seg["accept"] = max(0.0, (_CLOCK() - tnow)
                                 - (self._journal_s - j_mark)
                                 - (self._sink_s - s_mark))
             self.metrics.on_tick(dur, emitted, slot_ticks)
@@ -1836,7 +1838,7 @@ class Engine:
 
         seg["journal"] = self._journal_s - j_start
         seg["sink"] = self._sink_s - s_start
-        t_seg = time.monotonic()
+        t_seg = _CLOCK()
         self.metrics.observe_state(
             len(self.queue), self.n_active, self.cfg.slots)
         self.metrics.observe_cache(
@@ -1847,9 +1849,9 @@ class Engine:
                      active=self.n_active, queue=len(self.queue),
                      **({"alerts": self.slo.active_names()}
                         if self.slo is not None else {}))
-        seg["slo"] = time.monotonic() - t_seg
+        seg["slo"] = _CLOCK() - t_seg
         self.tickprof.record(self._tick_no, seg,
-                             time.monotonic() - p_start)
+                             _CLOCK() - p_start)
         if self.flight.due(self._tick_no):
             self.flight.spill("periodic", self._flight_payload(),
                               tick=self._tick_no)

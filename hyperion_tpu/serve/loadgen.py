@@ -20,6 +20,8 @@ from __future__ import annotations
 import dataclasses
 import time
 
+from hyperion_tpu.utils.clock import SYSTEM as _CLOCK
+
 import numpy as np
 
 from hyperion_tpu.obs.export import DEFAULT_WINDOW_S
@@ -201,11 +203,11 @@ def run_load(engine, spec: LoadSpec) -> dict:
                             shared_prefix_tokens=int(spec.shared_prefix_tokens),
                             n_requests=spec.n_requests)
 
-    t0 = time.monotonic()
+    t0 = _CLOCK()
     submitted = 0
     rejected = 0
     while submitted < spec.n_requests or not engine.idle:
-        now = time.monotonic() - t0
+        now = _CLOCK() - t0
         while submitted < spec.n_requests and arrivals[submitted] <= now:
             ok, _reason = engine.submit(reqs[submitted])
             rejected += 0 if ok else 1
@@ -215,12 +217,12 @@ def run_load(engine, spec: LoadSpec) -> dict:
                 break  # tail request door-rejected with nothing in flight
             # nothing in flight: sleep to the next arrival instead of
             # spinning the scheduler
-            nxt = arrivals[submitted] - (time.monotonic() - t0)
+            nxt = arrivals[submitted] - (_CLOCK() - t0)
             if nxt > 0:
                 time.sleep(min(nxt, 0.05))
             continue
         engine.step()
-    elapsed = time.monotonic() - t0
+    elapsed = _CLOCK() - t0
 
     cache = engine.metrics.summary()
     done = [r for r in reqs if r.status == "done"]
@@ -397,7 +399,7 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
                  if spec.adversary == "slowloris" and req.tenant
                  else 0.0)
         res = results[i]
-        sent = time.monotonic()
+        sent = _CLOCK()
         res["submitted_at"] = sent
         expected = 0  # next stream index owed — dup/gap audit
         try:
@@ -408,7 +410,7 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
                     if stall > 0:
                         time.sleep(stall)
                     if ev == "token" and rec.get("token") is not None:
-                        res.setdefault("first_token_at", time.monotonic())
+                        res.setdefault("first_token_at", _CLOCK())
                         res["tokens"] = res.get("tokens", 0) + 1
                         # exactly-once audit off the wire's stream
                         # index: an index below the expected one is a
@@ -424,7 +426,7 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
                     elif ev in ("done", "rejected", "timed_out",
                                 "error"):
                         res["status"] = ev
-                        res["finished_at"] = time.monotonic()
+                        res["finished_at"] = _CLOCK()
                         # replica-attributed TTFT rides the done record
                         # (serve/server.py): client TTFT minus this is
                         # the time the router + wire owned the request
@@ -435,12 +437,12 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
         except (OSError, ConnectionError) as e:
             res["status"] = "error"
             res["error"] = repr(e)
-            res["finished_at"] = time.monotonic()
+            res["finished_at"] = _CLOCK()
 
-    t0 = time.monotonic()
+    t0 = _CLOCK()
     threads: list[threading.Thread] = []
     for i in range(spec.n_requests):
-        wait = t0 + arrivals[i] - time.monotonic()
+        wait = t0 + arrivals[i] - _CLOCK()
         if wait > 0:
             time.sleep(wait)
         t = threading.Thread(target=drive, args=(i,),
@@ -449,7 +451,7 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
         threads.append(t)
     for t in threads:
         t.join(timeout=request_timeout_s)
-    elapsed = time.monotonic() - t0
+    elapsed = _CLOCK() - t0
 
     done = [r for r in results if r.get("status") == "done"]
     ttft_ms = [(r["first_token_at"] - r["submitted_at"]) * 1e3
@@ -460,7 +462,7 @@ def run_load_socket(socket_path: str, spec: LoadSpec, *,
     # the run's last exposition window — the socket driver cannot read
     # engine rings, so it computes the same "recent" view from its own
     # clocks
-    cut = time.monotonic() - DEFAULT_WINDOW_S
+    cut = _CLOCK() - DEFAULT_WINDOW_S
     ttft_win = [(r["first_token_at"] - r["submitted_at"]) * 1e3
                 for r in done
                 if "first_token_at" in r and r["first_token_at"] >= cut]

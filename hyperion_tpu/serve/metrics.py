@@ -21,10 +21,9 @@ throughput rides every existing reader.
 
 from __future__ import annotations
 
-import time
-
 from hyperion_tpu.obs.registry import MetricsRegistry
 from hyperion_tpu.serve.queue import SLA_CLASSES
+from hyperion_tpu.utils.clock import SYSTEM
 
 
 class ServeMetrics:
@@ -32,7 +31,7 @@ class ServeMetrics:
     writer, any tracer snapshot is the reader."""
 
     def __init__(self, registry: MetricsRegistry | None = None,
-                 clock=time.monotonic):
+                 clock=SYSTEM):
         self.reg = registry or MetricsRegistry()
         self._clock = clock
         self._t0 = clock()

@@ -33,11 +33,12 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
-import time
 from collections import deque
 from typing import Any, Callable
 
 import numpy as np
+
+from hyperion_tpu.utils.clock import SYSTEM
 
 _ids = itertools.count()
 
@@ -133,7 +134,9 @@ class Request:
         if not self.id:
             self.id = f"req_{next(_ids)}"
         if not self.submitted_at:
-            self.submitted_at = time.monotonic()
+            # construction-time stamp only; `submit` restamps at the
+            # door with the queue's own (possibly virtual) clock
+            self.submitted_at = SYSTEM()
         if not self.enqueued_at:
             self.enqueued_at = self.submitted_at
 
@@ -188,6 +191,7 @@ class AdmissionQueue:
         class_weights: dict[str, int] | None = None,
         class_capacity: dict[str, int] | None = None,
         class_deadline_s: dict[str, float] | None = None,
+        clock: Callable[[], float] | None = None,
     ):
         """`max_total_tokens` = the engine's per-slot cache length: a
         request whose prompt + max_new_tokens cannot fit is rejected at
@@ -221,6 +225,9 @@ class AdmissionQueue:
         self.gate_blocked: frozenset[str] = frozenset()
         self._lock = threading.Lock()
         self._closed: str | None = None  # reject reason once closed
+        # every time read in this queue goes through the injected clock
+        # so the fleet simulator can run it on virtual time
+        self._clock = clock if clock is not None else SYSTEM
 
     # ------------------------------------------------------------ admit
 
@@ -232,7 +239,7 @@ class AdmissionQueue:
         # (loadgen builds its whole arrival schedule up front): the life
         # clock — TTFT/e2e/deadline/queue_wait — starts at the door,
         # else pre-submit idle time masquerades as queue wait
-        req.submitted_at = req.enqueued_at = time.monotonic()
+        req.submitted_at = req.enqueued_at = self._clock()
         if self._closed is not None:
             # graceful drain: the door is shut, in-flight work finishes.
             # Checked first — a draining server's answer is "go away",
@@ -289,7 +296,7 @@ class AdmissionQueue:
         is consulted last, immediately before the pop, so a True
         return (which reserves blocks) always corresponds to a popped
         request."""
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         admit: list[Request] = []
         expired: list[Request] = []
         budget = self.prefill_budget
@@ -357,7 +364,7 @@ class AdmissionQueue:
         they resume first, so preemption degrades latency, never
         fairness."""
         req.status = "queued"
-        req.enqueued_at = time.monotonic()
+        req.enqueued_at = self._clock()
         with self._lock:
             self._qs[req.sla_class].appendleft(req)
 
@@ -394,7 +401,7 @@ class AdmissionQueue:
         Returned soonest-deadline first (most-doomed first); requests
         without deadlines are never shed here — with no SLO stated, the
         queue cannot call them hopeless."""
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         shed: list[Request] = []
         by_cls = est_wait_by_class or {}
         with self._lock:
@@ -415,7 +422,7 @@ class AdmissionQueue:
     def drop_expired(self, now: float | None = None) -> list[Request]:
         """Sweep expired requests without admitting (used while all
         slots are busy so waiting requests still time out on time)."""
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         expired: list[Request] = []
         with self._lock:
             for cls in SLA_CLASSES:

@@ -109,6 +109,7 @@ from hyperion_tpu.serve.queue import (
 from hyperion_tpu.serve.replica import SERVE_PHASES, READY, ReplicaHandle
 from hyperion_tpu.serve.router_journal import OrphanedDispatch, RouterJournal
 from hyperion_tpu.serve.server import _LineWriter, maybe_resume_doc
+from hyperion_tpu.utils.clock import SYSTEM
 from hyperion_tpu.utils.retry import RetryPolicy
 
 # connect policy for replica dispatch: generous enough to ride a
@@ -171,8 +172,13 @@ class RouterPolicy:
 
     def __init__(self, replicas: list[ReplicaHandle], *,
                  affinity_slack: int = 4, affinity_cap: int = 512,
-                 prefix_tokens: int = 32, prefix_chars: int = 128):
+                 prefix_tokens: int = 32, prefix_chars: int = 128,
+                 clock=None):
         self.replicas = list(replicas)
+        # wall-time source for eject/readmit decisions (heartbeats
+        # stamp t_wall); injectable so the fleet simulator can run the
+        # policy on virtual time
+        self._clock = clock if clock is not None else SYSTEM
         self.affinity_slack = affinity_slack
         self.affinity_cap = affinity_cap
         self.prefix_tokens = prefix_tokens
@@ -272,7 +278,7 @@ class RouterPolicy:
     def eject(self, rep: ReplicaHandle, reason: str,
               now: float | None = None) -> bool:
         """Mark a replica not-dispatchable; True on a transition."""
-        now = time.time() if now is None else now
+        now = self._clock.wall() if now is None else now
         with self._lock:
             was = rep.state == READY
             rep.eject(now, reason)
@@ -285,7 +291,7 @@ class RouterPolicy:
         ("ready"|"readmitted", replica) and ("ejected", replica,
         reason) — for the runtime to turn into events/metrics.
         `read_hb(path) -> dict | None` is injectable for tests."""
-        now = time.time() if now is None else now
+        now = self._clock.wall() if now is None else now
         # file I/O OUTSIDE the lock: a slow heartbeat read (NFS base
         # dir, big fleet) must never stall every relay's choose()
         beats = [read_hb(rep.heartbeat_path) for rep in self.replicas]
@@ -385,14 +391,128 @@ def _route_window_value(reg, metric: str, window_s: float,
     return None
 
 
+class FleetActions:
+    """The acting half of the monitor sweep — alert tallying,
+    steer/unsteer hysteresis, and the burning-count scale governor over
+    a `RouterPolicy` — factored free of threads, sockets, and
+    subprocesses. The live `Router` drives it from its monitor thread
+    with real side-effect callbacks (control-socket brownout orders,
+    child spawn/retire); the fleet simulator (`serve/simulate.py`)
+    drives the SAME object on a virtual clock with synthetic callbacks,
+    so steer/scale policy has exactly one implementation wherever it
+    runs."""
+
+    def __init__(self, policy: RouterPolicy, metrics: RouterMetrics,
+                 tracer, *, act: bool = True,
+                 steer_clear_sweeps: int = 3,
+                 scale_gov: BrownoutGovernor | None = None,
+                 order_brownout=None, scale_up=None, scale_down=None,
+                 scaling_paused=None, log=None):
+        self.policy = policy
+        self.metrics = metrics
+        self.tracer = tracer
+        self.act = bool(act)
+        self.steer_clear_sweeps = max(1, int(steer_clear_sweeps or 3))
+        self.scale_gov = scale_gov
+        self._order_brownout = order_brownout or (lambda rep, on: None)
+        self._scale_up = scale_up or (lambda: None)
+        self._scale_down = scale_down or (lambda: None)
+        self._scaling_paused = scaling_paused or (lambda: False)
+        self._log = log or (lambda msg: None)
+        # alert names already seen per replica, so the fleet tally
+        # counts RAISES, not beats
+        self._alert_seen: dict[int, set] = {}
+
+    def sweep_alerts(self) -> list[str]:
+        """Fleet alert surfacing: each replica's heartbeat carries the
+        SLO alerts its engine has FIRING (obs/slo.py); tally them so
+        one `obs top` row — and one router_end field — answers "is
+        anything alarming, anywhere" without opening N streams. New
+        names count as raises; a name persisting across beats does not
+        re-count. Only a DISPATCHABLE replica's alerts count: an
+        ejected/dead child's last beat would otherwise keep a ghost
+        alert firing fleet-wide forever (the dead replica itself is
+        already a named incident — its stale alarm must not page on
+        top of it). A restarted replica still alerting re-counts on
+        readmission: a new observation epoch, honestly re-raised."""
+        fleet_alerts: list[str] = []
+        new_raises = 0
+        for rep in self.policy.replicas:
+            cur = set(rep.hb_alerts) if rep.state == READY else set()
+            fleet_alerts += [f"r{rep.index}:{a}" for a in sorted(cur)]
+            fresh = cur - self._alert_seen.get(rep.index, set())
+            for a in sorted(fresh):
+                new_raises += 1
+                self.tracer.event("replica_alert", replica=rep.index,
+                                  alert=a)
+            self._alert_seen[rep.index] = cur
+        self.metrics.on_fleet_alerts(new_raises)
+        return fleet_alerts
+
+    @staticmethod
+    def burning(rep: ReplicaHandle) -> bool:
+        """A READY replica reporting any TTFT-family SLO alert on its
+        last beat — the one signal that says the LATENCY tier is being
+        hurt there right now (reject/availability alerts have their own
+        remedies: failover and restart already handle those)."""
+        return rep.state == READY and any("ttft" in a for a in rep.hb_alerts)
+
+    def sweep(self) -> int:
+        """Steer/unsteer each replica off its heartbeat alerts, then
+        feed the burning count to the scale governor. Returns the
+        burning count (rides the router heartbeat). No-op when not
+        acting — the fleet is then observed and tallied only."""
+        if not self.act:
+            return 0
+        burning = 0
+        for rep in self.policy.replicas:
+            if self.burning(rep):
+                burning += 1
+                if not rep.steered:
+                    self.policy.set_steered(rep, True)
+                    self.metrics.on_steer(True)
+                    self.tracer.event("router_steer", replica=rep.index,
+                                      on=True,
+                                      alerts=list(rep.hb_alerts))
+                    self._log(f"[route] replica {rep.index} steered: "
+                              f"{','.join(rep.hb_alerts)}")
+                    self._order_brownout(rep, True)
+                else:
+                    rep.steer_clear_sweeps = 0
+            elif rep.steered and rep.state == READY:
+                # hysteresis: only CONSECUTIVE alert-free sweeps of a
+                # beating replica count toward unsteer — an ejected
+                # replica's silence is not evidence of recovery
+                rep.steer_clear_sweeps += 1
+                if rep.steer_clear_sweeps >= self.steer_clear_sweeps:
+                    self.policy.set_steered(rep, False)
+                    self.metrics.on_steer(False)
+                    self.tracer.event("router_steer", replica=rep.index,
+                                      on=False)
+                    self._log(f"[route] replica {rep.index} unsteered "
+                              f"after {self.steer_clear_sweeps} clean "
+                              f"sweeps")
+                    self._order_brownout(rep, False)
+        self.metrics.observe_steered(
+            sum(1 for r in self.policy.replicas if r.steered))
+        if self.scale_gov is not None and not self._scaling_paused():
+            tr = self.scale_gov.update(burning)
+            if tr == "enter":
+                self._scale_up()
+            elif tr == "exit":
+                self._scale_down()
+        return burning
+
+
 class Router:
     """The running fleet: supervisor thread per replica, a heartbeat
     monitor, and one relay thread per in-flight request."""
 
     def __init__(self, args, tracer, hb,
                  metrics: RouterMetrics | None = None,
-                 child_argv_fn=replica_argv):
+                 child_argv_fn=replica_argv, clock=None):
         self.args = args
+        self._clock = clock if clock is not None else SYSTEM
         self.tracer = tracer
         self.hb = hb
         self.metrics = metrics or RouterMetrics()
@@ -411,7 +531,8 @@ class Router:
         self.policy = RouterPolicy(
             self.replicas,
             affinity_slack=args.affinity_slack,
-            prefix_tokens=args.affinity_prefix)
+            prefix_tokens=args.affinity_prefix,
+            clock=self._clock)
         self._procs: dict[int, subprocess.Popen] = {}
         self._sup_threads: list[threading.Thread] = []
         self._req_threads: list[threading.Thread] = []
@@ -447,10 +568,6 @@ class Router:
         self._recovered: dict[str, OrphanedDispatch] = {}
         self._mon_stop = threading.Event()
         self._mon_thread: threading.Thread | None = None
-        # live plane: alert names already seen per replica (so the
-        # fleet tally counts RAISES, not beats), the router's own SLO
-        # monitor (route-level reject rate), and the exposition socket
-        self._fleet_alert_seen: dict[int, set] = {}
         # acting state (PR 14): steer hysteresis + the scale governor.
         # The governor is the queue's own BrownoutGovernor watching the
         # count of BURNING replicas as its "depth" — enter (>=1 burning)
@@ -464,6 +581,17 @@ class Router:
         self._scale_gov = None
         if self._act and self._max_replicas > len(self.replicas):
             self._scale_gov = BrownoutGovernor(depth_high=1)
+        # the shared steer/scale sweep (FleetActions): the Router wires
+        # in its real side effects — control-socket brownout orders and
+        # child spawn/retire — where the simulator wires synthetic ones
+        self.actions = FleetActions(
+            self.policy, self.metrics, tracer,
+            act=self._act,
+            steer_clear_sweeps=self._steer_clear_sweeps,
+            scale_gov=self._scale_gov,
+            order_brownout=self._order_class_brownout,
+            scale_up=self._scale_up, scale_down=self._scale_down,
+            scaling_paused=self._stopping.is_set, log=self._log)
         self._exporter = None
         self._slo = None
         route_budget = getattr(args, "slo_reject_rate", 0.0) or 0.0
@@ -513,7 +641,7 @@ class Router:
         pid = hb.get("pid")
         if hb.get("phase") not in SERVE_PHASES \
                 or not isinstance(t_wall, (int, float)) \
-                or time.time() - float(t_wall) > self.args.stale_s \
+                or self._clock.wall() - float(t_wall) > self.args.stale_s \
                 or not isinstance(pid, int) or pid <= 0:
             return None
         try:
@@ -536,7 +664,7 @@ class Router:
             except (OSError, ProcessLookupError):
                 return False
             if hang > 0 and rep.hb_t_wall is not None \
-                    and time.time() - rep.hb_t_wall > hang:
+                    and self._clock.wall() - rep.hb_t_wall > hang:
                 # wedged exactly like a spawned child would be: the
                 # watchdog contract applies to adoptees too
                 self._log(f"[route] adopted replica {rep.index} "
@@ -673,41 +801,13 @@ class Router:
         }
 
     def _sweep_fleet_alerts(self) -> list[str]:
-        """Fleet alert surfacing: each replica's heartbeat carries the
-        SLO alerts its engine has FIRING (obs/slo.py); the router
-        tallies them so one `obs top` row — and one router_end field —
-        answers "is anything alarming, anywhere" without opening N
-        streams. New names count as raises; a name persisting across
-        beats does not re-count. Only a DISPATCHABLE replica's alerts
-        count: an ejected/dead child's last beat would otherwise keep
-        a ghost alert firing fleet-wide forever (the dead replica
-        itself is already a named incident — its stale alarm must not
-        page on top of it). A restarted replica still alerting
-        re-counts on readmission: a new observation epoch, honestly
-        re-raised."""
-        fleet_alerts: list[str] = []
-        new_raises = 0
-        for rep in self.replicas:
-            cur = set(rep.hb_alerts) if rep.state == READY else set()
-            fleet_alerts += [f"r{rep.index}:{a}" for a in sorted(cur)]
-            fresh = cur - self._fleet_alert_seen.get(rep.index, set())
-            for a in sorted(fresh):
-                new_raises += 1
-                self.tracer.event("replica_alert", replica=rep.index,
-                                  alert=a)
-            self._fleet_alert_seen[rep.index] = cur
-        self.metrics.on_fleet_alerts(new_raises)
-        return fleet_alerts
+        """Delegates to the shared `FleetActions` sweep (the simulator
+        drives the same object)."""
+        return self.actions.sweep_alerts()
 
     # --------------------------------------------- acting on alerts
 
-    @staticmethod
-    def _burning(rep: ReplicaHandle) -> bool:
-        """A READY replica reporting any TTFT-family SLO alert on its
-        last beat — the one signal that says the LATENCY tier is being
-        hurt there right now (reject/availability alerts have their own
-        remedies: failover and restart already handle those)."""
-        return rep.state == READY and any("ttft" in a for a in rep.hb_alerts)
+    _burning = staticmethod(FleetActions.burning)
 
     def _order_class_brownout(self, rep: ReplicaHandle,
                               active: bool) -> None:
@@ -739,51 +839,11 @@ class Router:
                   f"{'' if acked else ' (no ack)'}")
 
     def _sweep_actions(self) -> int:
-        """The acting half of the monitor sweep: steer/unsteer per
-        replica off its heartbeat alerts, then feed the burning count
-        to the scale governor. Returns the burning count (rides the
-        router heartbeat). No-op under --no-act — the router then
-        observes and tallies exactly as PR 13 built it."""
-        if not self._act:
-            return 0
-        burning = 0
-        for rep in self.replicas:
-            if self._burning(rep):
-                burning += 1
-                if not rep.steered:
-                    self.policy.set_steered(rep, True)
-                    self.metrics.on_steer(True)
-                    self.tracer.event("router_steer", replica=rep.index,
-                                      on=True,
-                                      alerts=list(rep.hb_alerts))
-                    self._log(f"[route] replica {rep.index} steered: "
-                              f"{','.join(rep.hb_alerts)}")
-                    self._order_class_brownout(rep, True)
-                else:
-                    rep.steer_clear_sweeps = 0
-            elif rep.steered and rep.state == READY:
-                # hysteresis: only CONSECUTIVE alert-free sweeps of a
-                # beating replica count toward unsteer — an ejected
-                # replica's silence is not evidence of recovery
-                rep.steer_clear_sweeps += 1
-                if rep.steer_clear_sweeps >= self._steer_clear_sweeps:
-                    self.policy.set_steered(rep, False)
-                    self.metrics.on_steer(False)
-                    self.tracer.event("router_steer", replica=rep.index,
-                                      on=False)
-                    self._log(f"[route] replica {rep.index} unsteered "
-                              f"after {self._steer_clear_sweeps} clean "
-                              f"sweeps")
-                    self._order_class_brownout(rep, False)
-        self.metrics.observe_steered(
-            sum(1 for r in self.replicas if r.steered))
-        if self._scale_gov is not None and not self._stopping.is_set():
-            tr = self._scale_gov.update(burning)
-            if tr == "enter":
-                self._scale_up()
-            elif tr == "exit":
-                self._scale_down()
-        return burning
+        """The acting half of the monitor sweep (`--no-act` turns it
+        off — the router then observes and tallies exactly as PR 13
+        built it). Delegates to the shared `FleetActions` object."""
+        self.actions.act = self._act
+        return self.actions.sweep()
 
     def _scale_up(self) -> None:
         """Spawn one standby replica (the next index under the base
@@ -898,15 +958,15 @@ class Router:
             self.hb.beat(step=self.metrics.summary()["dispatched"],
                          phase="route", active=inflight, queue=0,
                          ready=ready, alerts=fleet_alerts)
-            now = time.monotonic()
+            now = self._clock()
             if now - last_snap >= 5.0:
                 self.tracer.snapshot(self.metrics.reg)
                 last_snap = now
             self._mon_stop.wait(poll_s)
 
     def wait_ready(self, n: int = 1, timeout_s: float = 120.0) -> bool:
-        t0 = time.monotonic()
-        while time.monotonic() - t0 < timeout_s:
+        t0 = self._clock()
+        while self._clock() - t0 < timeout_s:
             if self.policy.ready_count >= n:
                 return True
             if self._hard_stop.is_set():
@@ -952,7 +1012,7 @@ class Router:
             doc["id"] = f"route_{next(self._rids)}"
         rid = str(doc["id"])
         if self._stopping.is_set():
-            self._reject(rid, REJECT_DRAINING, time.monotonic(), writer)
+            self._reject(rid, REJECT_DRAINING, self._clock(), writer)
             return None
         # the WAL line: the request exactly as the client sent it (plus
         # the minted id) — what a NEXT router life needs to re-dispatch.
@@ -982,7 +1042,7 @@ class Router:
         self.metrics.on_reject(reason)
         self.tracer.event(
             "request_rejected", request=rid, reason=reason,
-            queued_s=round(max(0.0, time.monotonic() - submitted), 6))
+            queued_s=round(max(0.0, self._clock() - submitted), 6))
         if self.journal is not None:
             self.journal.done(rid, reason)
         writer.write({"id": rid, "event": "rejected", "reason": reason})
@@ -1011,7 +1071,7 @@ class Router:
         except Exception as e:  # noqa: BLE001 — a relay bug must reject
             # its request, never silently strand the client's stream
             try:
-                self._reject(rid, REJECT_BAD_REQUEST, time.monotonic(),
+                self._reject(rid, REJECT_BAD_REQUEST, self._clock(),
                              writer)
             except Exception:  # noqa: BLE001 — reject write to a dead
                 pass           # client must not mask the real error
@@ -1023,7 +1083,7 @@ class Router:
     def _relay_inner(self, rid: str, doc: dict, writer, *,
                      resume_from: int = 0, wal_line: str | None = None,
                      as_resume: bool = False, hop_base: int = 0) -> None:
-        submitted = time.monotonic()
+        submitted = self._clock()
         dedup = StreamDedup()
         # a resume (client-driven or WAL orphan re-dispatch) floors the
         # dedup at what was already forwarded — the replica recomputes
@@ -1046,7 +1106,7 @@ class Router:
         def _gap_done() -> None:
             nonlocal fail_at
             if fail_at is not None:
-                self.metrics.on_failover_gap(time.monotonic() - fail_at)
+                self.metrics.on_failover_gap(self._clock() - fail_at)
                 fail_at = None
 
         trace: dict = {"id": rid, "hop": hop_base, "attempt": 0,
@@ -1057,7 +1117,7 @@ class Router:
                 return
             rep, meta = self.policy.choose(doc, exclude=crashed | qfull)
             if rep is None:
-                if time.monotonic() > deadline:
+                if self._clock() > deadline:
                     self._reject(
                         rid,
                         REJECT_QUEUE_FULL if saw_qfull
@@ -1118,14 +1178,14 @@ class Router:
                 crashed.add(rep.index)
                 redispatches += 1
                 if fail_at is None:
-                    fail_at = time.monotonic()
+                    fail_at = self._clock()
                 self.metrics.on_redispatch("replica_lost")
                 self.tracer.event("route_redispatch", request=rid,
                                   from_replica=rep.index,
                                   reason="replica_lost",
                                   delivered=dedup.delivered,
                                   trace=trace)
-                deadline = max(deadline, time.monotonic()
+                deadline = max(deadline, self._clock()
                                + self.args.dispatch_timeout)
                 continue
             finally:
@@ -1150,7 +1210,7 @@ class Router:
                 "route_complete", request=rid, replica=rep.index,
                 status=outcome, tokens=dedup.delivered,
                 redispatches=redispatches,
-                e2e_s=round(time.monotonic() - submitted, 6),
+                e2e_s=round(self._clock() - submitted, 6),
                 trace=trace)
             return
 
@@ -1326,16 +1386,16 @@ class Router:
         # supervisor to register its Popen, or the signal pass below
         # misses it and the join runs out its whole budget before the
         # kill fallback can reach the late arrival
-        t0 = time.monotonic()
-        while time.monotonic() - t0 < 5.0 and any(
+        t0 = self._clock()
+        while self._clock() - t0 < 5.0 and any(
                 t.is_alive() and self._procs.get(rep.index) is None
                 for t, rep in zip(self._sup_threads, self.replicas)):
             time.sleep(0.05)
         signal_children()
         join_s = self.args.drain_timeout + 10.0
-        t0 = time.monotonic()
+        t0 = self._clock()
         for t in self._sup_threads:
-            t.join(timeout=max(0.5, join_s - (time.monotonic() - t0)))
+            t.join(timeout=max(0.5, join_s - (self._clock() - t0)))
         signal_children(kill=True)
         for t in self._sup_threads:
             t.join(timeout=5.0)
@@ -1712,7 +1772,7 @@ def main(argv=None) -> int:
     # dist lookup — the router must never touch a jax backend.
     tracer = obs_trace.from_env(
         str(base / "telemetry.jsonl"),
-        run=f"route_{int(time.time())}", proc=0, enabled_by_default=True)
+        run=f"route_{int(SYSTEM.wall())}", proc=0, enabled_by_default=True)
     hb = obs_heartbeat.Heartbeat.for_tracer(tracer, every=25)
     router = Router(args, tracer, hb)
     router.start()

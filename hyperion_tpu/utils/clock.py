@@ -1,0 +1,75 @@
+"""One clock to inject everywhere time is read.
+
+Before this module, three fake-clock idioms had grown independently:
+the obs registry's ``clock=`` callable, the SLO monitor's ``clock=`` +
+``now=`` overrides, and the brownout governor / queue's bare ``now=``
+parameters backed by direct ``time.monotonic()`` calls. The fleet
+simulator (serve/simulate.py) needs *every* policy-side time read to
+come from the same virtual clock, so the idioms unify here:
+
+* ``Clock`` — the real thing. Calling it returns ``time.monotonic()``;
+  ``.wall()`` returns ``time.time()``. The module singleton ``SYSTEM``
+  is the default everywhere, so production code never constructs one.
+* ``VirtualClock`` — a manually advanced clock for tests and the
+  simulator. It keeps SEPARATE monotonic and wall accumulators (like
+  the real pair: monotonic starts at an arbitrary epoch, wall at a
+  calendar one) that advance in lockstep, so telemetry written under
+  it carries stable wall stamps while durations stay exact.
+
+A ``Clock`` instance is itself a valid ``clock=`` callable for
+``MetricsRegistry``/``SLOMonitor``/``Tracer``, and ``clock.wall`` is a
+valid ``wall=`` callable — no adapters.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Clock:
+    """Real time: ``clock()`` is monotonic, ``clock.wall()`` is wall."""
+
+    def __call__(self) -> float:
+        return time.monotonic()
+
+    def wall(self) -> float:
+        return time.time()
+
+    def sleep(self, s: float) -> None:
+        time.sleep(max(0.0, s))
+
+
+#: Default clock for every injectable site — production code shares it.
+SYSTEM = Clock()
+
+
+class VirtualClock(Clock):
+    """A clock that moves only when told to.
+
+    ``t`` (monotonic) and ``t_wall`` advance together; they start from
+    independent epochs so fixtures can pin a calendar-plausible wall
+    base while keeping small round monotonic numbers. The 100.0
+    default keeps window math (``now - window_s``) away from zero.
+    """
+
+    def __init__(self, t: float = 100.0, wall0: float | None = None):
+        self.t = float(t)
+        self.t_wall = float(t if wall0 is None else wall0)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def wall(self) -> float:
+        return self.t_wall
+
+    def sleep(self, s: float) -> None:
+        self.advance(s)
+
+    def advance(self, s: float) -> None:
+        self.t += s
+        self.t_wall += s
+
+    def advance_to(self, t: float) -> None:
+        """Advance monotonic time to ``t`` (no-op if already past)."""
+        if t > self.t:
+            self.advance(t - self.t)

@@ -34,6 +34,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from hyperion_tpu.obs.diff import METRICS, ZERO_PINNED, normalize
 from hyperion_tpu.serve.loadgen import SERVING_REPORT_KEYS
+from hyperion_tpu.serve.simulate import DIFF_GATED, diff_key
 
 # the serving_scale row's keys are hardcoded in bench.py
 # `_child_serving_scale` (there is no shared vocabulary module for the
@@ -59,6 +60,12 @@ def synthetic_doc() -> dict:
                            "prefetch_batches_per_s": 1.0},
         "serving": {k: 1.0 for k in SERVING_REPORT_KEYS},
         "serving_scale": {k: 1.0 for k in SERVING_SCALE_KEYS},
+        # bench fleet_sim probe row: built from the simulator's OWN
+        # gate vocabulary (simulate.DIFF_GATED via diff_key), so a
+        # scenario/key rename there orphans the diff.py gate loudly
+        "fleet_sim": {diff_key(scn, k): 1.0
+                      for scn, keys in DIFF_GATED.items()
+                      for k in keys},
         # trainer *_summary.json
         "step_ms": 1.0, "peak_hbm_mb": 1.0,
     }
@@ -71,9 +78,22 @@ def orphaned_gates() -> list[str]:
     return sorted(set(METRICS) - producible)
 
 
+def ungated_sim_keys() -> list[str]:
+    """Simulator DIFF_GATED names missing from METRICS — a gate the
+    simulator promises but `obs diff` never enforces (sorted)."""
+    promised = {diff_key(scn, k)
+                for scn, keys in DIFF_GATED.items() for k in keys}
+    return sorted(promised - set(METRICS))
+
+
 def main(argv: list[str] | None = None) -> int:
     orphans = orphaned_gates()
     unpinned = sorted(set(ZERO_PINNED) - set(METRICS))
+    ungated = ungated_sim_keys()
+    if ungated:
+        print("check_diff_gates: FAIL — simulate.DIFF_GATED name(s) "
+              f"not gated in obs/diff.py METRICS: {', '.join(ungated)}",
+              file=sys.stderr)
     if orphans:
         print("check_diff_gates: FAIL — gated but unproducible "
               f"metric(s): {', '.join(orphans)} — the emitter key was "
@@ -82,7 +102,7 @@ def main(argv: list[str] | None = None) -> int:
     if unpinned:
         print("check_diff_gates: FAIL — ZERO_PINNED name(s) not in "
               f"METRICS: {', '.join(unpinned)}", file=sys.stderr)
-    if orphans or unpinned:
+    if orphans or unpinned or ungated:
         return 1
     print(f"check_diff_gates: OK — {len(METRICS)} gated metric(s), "
           "all producible from emitter vocabularies")

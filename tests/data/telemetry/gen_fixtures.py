@@ -31,6 +31,13 @@ test asserts the record fields stay stable):
                 exactly ONE `alert_cleared`. `obs doctor` must name the
                 resolved alert; the schema test pins the event payloads
                 (tests/test_obs_live.py)
+    sim/      — a small pinned flight-simulator failover run
+                (serve/simulate.py): the real policy code on a virtual
+                clock, half the fleet killed and readmitted. Pins the
+                simulator's telemetry contract (`sim_scenario`,
+                `sim_report` + the standard router vocabulary) so
+                doctor/diff keep consuming simulator output unchanged
+                (tests/test_obs_doctor.py, tests/test_simulate.py)
 
 Everything is driven by fake clocks pinned to _WALL0 so the files are
 byte-stable across regenerations (no real time leaks in). The committed
@@ -50,31 +57,25 @@ from hyperion_tpu.obs.health import HealthConfig, HealthMonitor  # noqa: E402
 from hyperion_tpu.obs.heartbeat import Heartbeat  # noqa: E402
 from hyperion_tpu.obs.registry import MetricsRegistry  # noqa: E402
 from hyperion_tpu.obs.trace import Tracer  # noqa: E402
+from hyperion_tpu.utils.clock import VirtualClock  # noqa: E402
 
 _WALL0 = 1754000000.0  # 2026-07-31T21:33:20Z — fixed so fixtures are stable
 _OUT = Path(__file__).resolve().parent
-
-
-class Clock:
-    def __init__(self, t: float):
-        self.t = t
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, s: float) -> None:
-        self.t += s
 
 
 def _setup(name: str, run: str):
     d = _OUT / name
     d.mkdir(parents=True, exist_ok=True)
     (d / "telemetry.jsonl").unlink(missing_ok=True)
-    clk, wall = Clock(100.0), Clock(_WALL0)
-    t = Tracer(d / "telemetry.jsonl", run=run, proc=0, clock=clk, wall=wall)
+    # one VirtualClock carries both accumulators: clk() is the
+    # monotonic read, clk.wall the wall read — the same object the
+    # simulator and the fake-clock tests inject
+    clk = VirtualClock(100.0, wall0=_WALL0)
+    t = Tracer(d / "telemetry.jsonl", run=run, proc=0, clock=clk,
+               wall=clk.wall)
     hb = Heartbeat(d / "heartbeat.json", run=run, proc=0, every=1,
-                   clock=clk, wall=wall)
-    return d, t, hb, clk, wall
+                   clock=clk, wall=clk.wall)
+    return d, t, hb, clk
 
 
 def _snapshot(t: Tracer, step: int, tokens_per_s: float = 4096.0):
@@ -89,24 +90,22 @@ def _snapshot(t: Tracer, step: int, tokens_per_s: float = 4096.0):
     t.snapshot(reg, step=step, epoch=1)
 
 
-def _steps(t: Tracer, hb: Heartbeat, clk, wall, durs_ms, start=0):
+def _steps(t: Tracer, hb: Heartbeat, clk, durs_ms, start=0):
     for i, ms in enumerate(durs_ms, start):
         with t.span("train_step", step=i):
             clk.advance(ms / 1e3)
-            wall.advance(ms / 1e3)
         hb.beat(step=i, phase="train", epoch=1)
 
 
 def healthy():
-    d, t, hb, clk, wall = _setup("healthy", "fix_healthy")
+    d, t, hb, clk = _setup("healthy", "fix_healthy")
     t.event("train_start", job="language_ddp", n_devices=8, epochs=1)
     with t.span("epoch", step=0) as ep:
-        _steps(t, hb, clk, wall, [10.0] * 8)
+        _steps(t, hb, clk, [10.0] * 8)
         ep.set(epoch=1, steps=8)
     _snapshot(t, 8)
     with t.span("checkpoint", epoch=1):
         clk.advance(0.2)
-        wall.advance(0.2)
     hb.pulse(step=8, phase="checkpoint", epoch=1)
     t.event("train_end", preempted=False, epochs_run=1)
     hb.close(phase="done")
@@ -114,7 +113,7 @@ def healthy():
 
 
 def nan():
-    d, t, hb, clk, wall = _setup("nan", "fix_nan")
+    d, t, hb, clk = _setup("nan", "fix_nan")
     mon = HealthMonitor(HealthConfig(policy="abort"), tracer=t)
     t.event("train_start", job="language_ddp", n_devices=8, epochs=1)
     losses = [4.0, 3.8, 3.7, 3.6, 3.9, float("nan")]
@@ -123,7 +122,6 @@ def nan():
         for i, loss in enumerate(losses):
             with t.span("train_step", step=i):
                 clk.advance(0.010)
-                wall.advance(0.010)
             hb.beat(step=i, phase="train", epoch=1)
             action = mon.observe_step(i, loss=loss, grad_norm=1.0,
                                       step_time_s=0.010)
@@ -140,20 +138,20 @@ def nan():
 
 
 def stalled():
-    d, t, hb, clk, wall = _setup("stalled", "fix_stalled")
+    d, t, hb, clk = _setup("stalled", "fix_stalled")
     t.event("train_start", job="language_ddp", n_devices=8, epochs=1)
     # the epoch span never closes: the run was still inside it
     t._stack.append("epoch")
-    _steps(t, hb, clk, wall, [10.0] * 8 + [500.0, 520.0, 540.0])
+    _steps(t, hb, clk, [10.0] * 8 + [500.0, 520.0, 540.0])
     t.flush()
     t.close()
 
 
 def hung():
-    d, t, hb, clk, wall = _setup("hung", "fix_hung")
+    d, t, hb, clk = _setup("hung", "fix_hung")
     t.event("train_start", job="language_ddp", n_devices=8, epochs=1)
     t._stack.append("epoch")
-    _steps(t, hb, clk, wall, [10.0] * 6)
+    _steps(t, hb, clk, [10.0] * 6)
     t.flush()
     t.close()
     # the heartbeat froze in phase "train" — wall-clock staleness (vs a
@@ -161,10 +159,10 @@ def hung():
 
 
 def crashed():
-    d, t, hb, clk, wall = _setup("crashed", "fix_crashed")
+    d, t, hb, clk = _setup("crashed", "fix_crashed")
     t.event("train_start", job="language_ddp", n_devices=8, epochs=1)
     t._stack.append("epoch")
-    _steps(t, hb, clk, wall, [10.0] * 5)
+    _steps(t, hb, clk, [10.0] * 5)
     t.flush()
     t.close()
     # SIGKILL mid-record: the stream's last line is a fragment a reader
@@ -178,11 +176,9 @@ def serve():
     every `request_finished` decomposes exactly (components + other ==
     e2e) and queue wait owns ~80% of the p99 TTFT — the named-incident
     threshold case for `obs doctor`."""
-    d, t, hb, clk, wall = _setup("serve", "fix_serve")
+    d, t, hb, clk = _setup("serve", "fix_serve")
 
-    def adv(s: float) -> None:
-        clk.advance(s)
-        wall.advance(s)
+    adv = clk.advance
 
     t.event("serve_start", slots=2, max_len=64, block_size=8,
             num_blocks=17, prefix_cache=True)
@@ -277,11 +273,9 @@ def slo():
     runs — the fixture just pins its wire records."""
     from hyperion_tpu.obs import slo as slo_mod
 
-    d, t, hb, clk, wall = _setup("slo", "fix_slo")
+    d, t, hb, clk = _setup("slo", "fix_slo")
 
-    def adv(s: float) -> None:
-        clk.advance(s)
-        wall.advance(s)
+    adv = clk.advance
 
     reg = MetricsRegistry(clock=clk)
     # min_count scaled down with the windows: the 2s fast window at
@@ -363,8 +357,12 @@ def fleet():
         d.mkdir(parents=True, exist_ok=True)
         (d / "telemetry.jsonl").unlink(missing_ok=True)
 
-    wall = Clock(_WALL0)          # the host clock every process shares
-    rclk, c0, c1 = Clock(100.0), Clock(50.0), Clock(60.0)
+    # the shared host wall clock is a VirtualClock CALLED directly (its
+    # monotonic accumulator plays the wall role); the per-process
+    # monotonic clocks start from distinct bases to create the skew
+    wall = VirtualClock(_WALL0)   # the host clock every process shares
+    rclk, c0, c1 = (VirtualClock(100.0), VirtualClock(50.0),
+                    VirtualClock(60.0))
 
     def adv(s: float) -> None:
         wall.advance(s)
@@ -521,6 +519,32 @@ def fleet():
     h1.pulse(phase="serve", step=2, active=1, queue=0)
 
 
+def sim():
+    """Golden flight-simulator stream: a small pinned failover scenario
+    (4 replicas, 150 requests, half the fleet killed at t=60) played on
+    the REAL discrete-event harness (serve/simulate.py). Everything is
+    virtual-clocked off the same _WALL0 base the other fixtures use, so
+    regeneration is byte-stable. The stream carries the simulator's own
+    vocabulary (`sim_scenario`, `sim_report`) alongside the standard
+    router/serve events — the contract tests pin that `obs doctor` and
+    `obs diff` consume it with no sim-specific code paths."""
+    from hyperion_tpu.serve import simulate as sim_mod
+
+    d = _OUT / "sim"
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "telemetry.jsonl").unlink(missing_ok=True)
+    scn = dict(sim_mod.SCENARIOS["failover"])
+    scn.update(replicas=4, requests=150, duration_s=90.0)
+    # asserts rescaled to the fixture's size (half of 4 = 2 deaths);
+    # the fixture must be a PASSING run — its sim_report pins ok=true
+    scn["assert"] = {"completed_rate": {"min": 0.80},
+                     "duplicate_tokens": {"max": 0},
+                     "ejections": {"min": 2},
+                     "readmits": {"min": 2}}
+    res = sim_mod.run_scenario(scn, out=str(d))
+    assert res["ok"], res["asserts"]
+
+
 def main() -> int:
     from unittest import mock
 
@@ -531,7 +555,7 @@ def main() -> int:
             mock.patch("hyperion_tpu.obs.heartbeat.host_rss_mb",
                        return_value=20.5):
         for fn in (healthy, nan, stalled, hung, crashed, serve, slo,
-                   fleet):
+                   fleet, sim):
             fn()
             print(f"wrote {fn.__name__}/")
     return 0

@@ -74,6 +74,18 @@ GOOD_SCALE = {"replicas": 2, "tokens_per_s_1r": 400.0,
               "request_share": {"0": 0.5, "1": 0.5}, "fairness": 1.0,
               "affinity_hit_rate": 0.6, "completed": 16,
               "router_overhead_p99_ms": 3.5, "failover_gap_p99_ms": 0.0}
+GOOD_FLEET_SIM = {"sim_herd_shed_rate": 0.2,
+                  "sim_herd_completed_rate": 0.7,
+                  "sim_herd_interactive_ttft_p99_ms": 400.0,
+                  "sim_herd_alerts_raised": 3.0,
+                  "sim_herd_duplicate_tokens": 0.0,
+                  "sim_herd_ok": True, "sim_herd_wall_s": 5.0,
+                  "sim_failover_completed_rate": 1.0,
+                  "sim_failover_interactive_ttft_p99_ms": 250.0,
+                  "sim_failover_gap_p99_ms": 1200.0,
+                  "sim_failover_steer_reversals": 0.0,
+                  "sim_failover_duplicate_tokens": 0.0,
+                  "sim_failover_ok": True, "sim_failover_wall_s": 3.0}
 GOOD_MEASUREMENT = {
     "tflops": 150.0, "per_iter_ms": 7.0, "amortized_ms": 7.0,
     "dispatch_overhead_ms": 60.0, "chain_lengths": [16, 48],
@@ -109,6 +121,7 @@ class TestBenchMain:
             "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
             "--child-serving": (30, GOOD_SERVING, ""),
             "--child-serving-scale": (40, GOOD_SCALE, ""),
+            "--child-fleet-sim": (10, GOOD_FLEET_SIM, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -122,6 +135,9 @@ class TestBenchMain:
         # the cross-process keys `obs diff` gates must ride the row
         assert out["serving_scale"]["router_overhead_p99_ms"] == 3.5
         assert out["serving_scale"]["failover_gap_p99_ms"] == 0.0
+        # the flight-simulator row rides under its canonical diff keys
+        assert out["fleet_sim"]["sim_herd_completed_rate"] == 0.7
+        assert out["fleet_sim"]["sim_failover_duplicate_tokens"] == 0.0
 
     def test_dead_tunnel_emits_failure_with_sanity(self, bench, clock,
                                                    capsys, monkeypatch):
@@ -134,6 +150,7 @@ class TestBenchMain:
             "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
             "--child-serving": (30, GOOD_SERVING, ""),
             "--child-serving-scale": (40, GOOD_SCALE, ""),
+            "--child-fleet-sim": (10, GOOD_FLEET_SIM, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -161,6 +178,7 @@ class TestBenchMain:
             "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
             "--child-serving": (30, GOOD_SERVING, ""),
             "--child-serving-scale": (40, GOOD_SCALE, ""),
+            "--child-fleet-sim": (10, GOOD_FLEET_SIM, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -203,6 +221,7 @@ class TestBenchMain:
             "--child-input-pipeline": (30, GOOD_PIPELINE, ""),
             "--child-serving": (30, GOOD_SERVING, ""),
             "--child-serving-scale": (40, GOOD_SCALE, ""),
+            "--child-fleet-sim": (10, GOOD_FLEET_SIM, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -212,7 +231,8 @@ class TestBenchMain:
         assert names[0] == "bench_start"
         for expected in ("probe_attempt", "probe_result",
                          "measure_attempt", "measure_result",
-                         "input_pipeline", "serving", "publish"):
+                         "input_pipeline", "fleet_sim", "serving",
+                         "publish"):
             assert expected in names, names
         publish = [json.loads(line)
                    for line in tele.read_text().splitlines()][-1]
@@ -230,6 +250,7 @@ class TestBenchMain:
             "--child-input-pipeline": (10_000, None, ""),
             "--child-serving": (10_000, None, ""),
             "--child-serving-scale": (10_000, None, ""),
+            "--child-fleet-sim": (10_000, None, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)

@@ -30,17 +30,7 @@ from hyperion_tpu.obs.heartbeat import (
     read_heartbeat,
 )
 from hyperion_tpu.obs.trace import Tracer
-
-
-class FakeClock:
-    def __init__(self, t: float = 100.0):
-        self.t = t
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, s: float) -> None:
-        self.t += s
+from hyperion_tpu.utils.clock import VirtualClock
 
 
 class TestHealthMonitor:
@@ -180,7 +170,7 @@ class TestHealthMonitor:
 
 class TestHeartbeat:
     def make(self, tmp_path, **kw):
-        clk, wall = FakeClock(100.0), FakeClock(1_000_000.0)
+        clk, wall = VirtualClock(100.0), VirtualClock(1_000_000.0)
         kw.setdefault("every", 5)
         hb = Heartbeat(tmp_path / "heartbeat.json", run="r1", proc=2,
                        clock=clk, wall=wall, **kw)

@@ -26,17 +26,7 @@ from hyperion_tpu.obs.registry import (
     percentile,
 )
 from hyperion_tpu.obs.trace import ENV_VAR, Tracer, from_env, null_tracer
-
-
-class FakeClock:
-    def __init__(self, t: float = 100.0):
-        self.t = t
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, s: float) -> None:
-        self.t += s
+from hyperion_tpu.utils.clock import VirtualClock
 
 
 def read_jsonl(path) -> list[dict]:
@@ -44,8 +34,8 @@ def read_jsonl(path) -> list[dict]:
 
 
 def make_tracer(tmp_path, **kw):
-    clk = FakeClock(100.0)
-    wall = FakeClock(1_000_000.0)
+    clk = VirtualClock(100.0)
+    wall = VirtualClock(1_000_000.0)
     kw.setdefault("run", "r1")
     kw.setdefault("proc", 3)
     t = Tracer(tmp_path / "t.jsonl", clock=clk, wall=wall, **kw)
@@ -268,8 +258,8 @@ def write_fixture_stream(path, runs=("r1", "r2")):
     """A small synthetic stream: per run, 4 train steps + 1 epoch span +
     a snapshot + events — what a 1-epoch smoke train emits."""
     for i, run in enumerate(runs):
-        clk = FakeClock(10.0)
-        wall = FakeClock(1_000.0 + 100 * i)
+        clk = VirtualClock(10.0)
+        wall = VirtualClock(1_000.0 + 100 * i)
         t = Tracer(path, run=run, proc=0, clock=clk, wall=wall)
         t.event("train_start", job="language_ddp")
         with t.span("epoch", step=0) as ep:

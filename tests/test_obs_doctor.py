@@ -19,6 +19,7 @@ from hyperion_tpu.obs import doctor, report
 from hyperion_tpu.obs.heartbeat import read_heartbeat
 from hyperion_tpu.obs.registry import MetricsRegistry
 from hyperion_tpu.obs.trace import Tracer
+from hyperion_tpu.utils.clock import VirtualClock
 
 FIXTURES = Path(__file__).parent / "data" / "telemetry"
 REPO = Path(__file__).resolve().parents[1]
@@ -30,24 +31,17 @@ ALL_FIXTURES = ("healthy", "nan", "stalled", "hung", "crashed", "serve",
 # join (tests/test_fleet_trace.py) only works if every constituent
 # stream honors the same envelope the single-process tools read
 FLEET_FIXTURES = ("fleet", "fleet/replica_0", "fleet/replica_1")
-
-
-class FakeClock:
-    def __init__(self, t: float):
-        self.t = t
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, s: float) -> None:
-        self.t += s
+# the flight-simulator fixture (serve/simulate.py) is events+snapshots
+# only — like the fleet router stream it has no tick spans, so it joins
+# the envelope and heartbeat contracts but not the span contract
+SIM_FIXTURES = ("sim",)
 
 
 def write_run(path, run: str, step_ms: float, *, steps: int = 8,
               tokens_per_s: float = 4096.0, wall0: float = 1_000.0,
               terminal: bool = True):
     """One synthetic healthy-shaped run appended to `path`."""
-    clk, wall = FakeClock(100.0), FakeClock(wall0)
+    clk, wall = VirtualClock(100.0), VirtualClock(wall0)
     t = Tracer(path, run=run, proc=0, clock=clk, wall=wall)
     t.event("train_start", job="language_ddp")
     with t.span("epoch", step=0) as ep:
@@ -70,7 +64,7 @@ def write_input_wait_run(path, run: str, frac: float, wait_s: float = 8.0):
     """A finished run whose last snapshot carries the input-wait gauges
     (`observe_input_wait`) — the evidence `doctor` reads for the
     input-bound call."""
-    clk, wall = FakeClock(100.0), FakeClock(1_000.0)
+    clk, wall = VirtualClock(100.0), VirtualClock(1_000.0)
     t = Tracer(path, run=run, proc=0, clock=clk, wall=wall)
     t.event("train_start", job="language_ddp")
     reg = MetricsRegistry()
@@ -245,7 +239,7 @@ def write_spec_serve_run(path, run: str, drafted: int, accepted: int,
                          tokens_per_tick: float = 1.4):
     """A finished serve-shaped run whose last snapshot carries the
     speculative-decoding counters/gauges (serve/metrics.py `on_spec`)."""
-    clk, wall = FakeClock(100.0), FakeClock(1_000.0)
+    clk, wall = VirtualClock(100.0), VirtualClock(1_000.0)
     t = Tracer(path, run=run, proc=0, clock=clk, wall=wall)
     t.event("serve_start")
     reg = MetricsRegistry()
@@ -308,7 +302,7 @@ class TestTenantAttributionAndRouterActions:
     router_scale) rolls up into one narrated line."""
 
     def _run(self, tmp_path, events):
-        clk, wall = FakeClock(100.0), FakeClock(1_000.0)
+        clk, wall = VirtualClock(100.0), VirtualClock(1_000.0)
         t = Tracer(tmp_path / "telemetry.jsonl", run="r1", proc=0,
                    clock=clk, wall=wall)
         t.event("serve_start")
@@ -389,7 +383,7 @@ class TestRouterWalPostMortem:
     evidence, read-only (recovery belongs to the next router life)."""
 
     def _tele(self, tmp_path, *, ended: bool):
-        clk, wall = FakeClock(100.0), FakeClock(1_000.0)
+        clk, wall = VirtualClock(100.0), VirtualClock(1_000.0)
         t = Tracer(tmp_path / "telemetry.jsonl", run="r1", proc=0,
                    clock=clk, wall=wall)
         t.event("router_start", replicas=2)
@@ -449,7 +443,7 @@ def write_rss_run(path, run: str, series):
     """A finished serve-shaped run whose snapshots carry the host RSS
     gauge as a SERIES — the evidence `doctor` reads for the host-leak
     trend."""
-    clk, wall = FakeClock(100.0), FakeClock(1_000.0)
+    clk, wall = VirtualClock(100.0), VirtualClock(1_000.0)
     t = Tracer(path, run=run, proc=0, clock=clk, wall=wall)
     t.event("serve_start")
     for i, mb in enumerate(series):
@@ -543,7 +537,8 @@ class TestRecordContract:
         assert out, f"fixture {name} unreadable"
         return out
 
-    @pytest.mark.parametrize("name", ALL_FIXTURES + FLEET_FIXTURES)
+    @pytest.mark.parametrize(
+        "name", ALL_FIXTURES + FLEET_FIXTURES + SIM_FIXTURES)
     def test_every_record_carries_envelope(self, name):
         for r in self.records(name):
             assert r["v"] == 1
@@ -584,7 +579,8 @@ class TestRecordContract:
         assert ev["fatal"] is True
         assert ev["action"] in ("warn", "checkpoint", "abort")
 
-    @pytest.mark.parametrize("name", ALL_FIXTURES + FLEET_FIXTURES)
+    @pytest.mark.parametrize(
+        "name", ALL_FIXTURES + FLEET_FIXTURES + SIM_FIXTURES)
     def test_heartbeat_contract(self, name):
         hb = read_heartbeat(FIXTURES / name / "heartbeat.json")
         assert hb is not None
@@ -595,7 +591,8 @@ class TestRecordContract:
                            ("t_mono", (int, float)), ("beats", int)):
             assert isinstance(hb[field], typ), (name, field)
 
-    @pytest.mark.parametrize("name", ALL_FIXTURES + FLEET_FIXTURES)
+    @pytest.mark.parametrize(
+        "name", ALL_FIXTURES + FLEET_FIXTURES + SIM_FIXTURES)
     def test_heartbeat_reader_tolerates_unknown_fields(self, name, tmp_path):
         """Live-plane payload growth (alerts, occupancy, whatever comes
         next) must never break an older reader: read_heartbeat returns

@@ -25,20 +25,10 @@ from hyperion_tpu.obs.export import (
 )
 from hyperion_tpu.obs.registry import MetricsRegistry, percentile
 from hyperion_tpu.obs.trace import Tracer
+from hyperion_tpu.utils.clock import VirtualClock
 
 FIXTURES = Path(__file__).parent / "data" / "telemetry"
 REPO = Path(__file__).resolve().parents[1]
-
-
-class FakeClock:
-    def __init__(self, t: float = 100.0):
-        self.t = t
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, s: float) -> None:
-        self.t += s
 
 
 # ------------------------------------------------------ windowed math
@@ -49,7 +39,7 @@ class TestWindowedInstruments:
         """The windowed p99 over a window covering EVERYTHING must
         equal the offline nearest-rank percentile the timeline tools
         compute — one percentile definition, live and post-hoc."""
-        clk = FakeClock()
+        clk = VirtualClock()
         reg = MetricsRegistry(clock=clk)
         h = reg.histogram("ttft_ms")
         vals = [float(7 * i % 53) for i in range(40)]
@@ -63,7 +53,7 @@ class TestWindowedInstruments:
         assert w["mean"] == pytest.approx(sum(vals) / len(vals))
 
     def test_histogram_window_drops_old_observations(self):
-        clk = FakeClock()
+        clk = VirtualClock()
         reg = MetricsRegistry(clock=clk)
         h = reg.histogram("x")
         h.observe(1000.0)          # t=100
@@ -80,7 +70,7 @@ class TestWindowedInstruments:
         assert h.windowed(10.0) == {"count": 0}
 
     def test_counter_windowed_delta(self):
-        clk = FakeClock()
+        clk = VirtualClock()
         reg = MetricsRegistry(clock=clk)
         c = reg.counter("tokens")
         c.inc(5)
@@ -93,7 +83,7 @@ class TestWindowedInstruments:
         assert c.windowed_delta(60.0) == 0
 
     def test_gauge_windowed_envelope(self):
-        clk = FakeClock()
+        clk = VirtualClock()
         reg = MetricsRegistry(clock=clk)
         g = reg.gauge("queue_depth")
         g.set(3.0)
@@ -106,7 +96,7 @@ class TestWindowedInstruments:
         assert g.windowed(60.0)["count"] == 2
 
     def test_windowed_snapshot_shape(self):
-        clk = FakeClock()
+        clk = VirtualClock()
         reg = MetricsRegistry(clock=clk)
         reg.counter("tokens").inc(30)
         reg.gauge("q").set(2.0)
@@ -128,7 +118,7 @@ class TestWindowedInstruments:
         """A counter busier than its ring cap covers less history than
         the asked-for window; the rate must divide by the COVERED
         span, not the window, or 100 tokens/s reads as 13.65."""
-        clk = FakeClock()
+        clk = VirtualClock()
         reg = MetricsRegistry(clock=clk)
         c = reg.counter("tokens")
         for _ in range(10_000):          # 100/s for 100s; ring cap 8192
@@ -150,7 +140,7 @@ class TestWindowedInstruments:
         computed over the span EVERY involved ring still covers — a
         truncated busy accept stream against an untruncated rare
         reject stream would otherwise inflate the rate."""
-        clk = FakeClock()
+        clk = VirtualClock()
         reg = MetricsRegistry(clock=clk)
         rej, acc = reg.counter("serve_rejected"), \
             reg.counter("serve_accepted")
@@ -185,7 +175,7 @@ class TestBurnRate:
         also be burning. Feed one burst, evaluate before the slow
         window has enough history... both windows see the same burst
         here, so instead pin the asymmetric case: bad-fast/good-slow."""
-        clk = FakeClock()
+        clk = VirtualClock()
         reg = MetricsRegistry(clock=clk)
         mon = _mon(clk, reg, fast=10.0, slow=60.0)
         h = reg.histogram("ttft_ms")
@@ -212,7 +202,7 @@ class TestBurnRate:
         alert (hovering at 4x burn never re-raises), the load drops,
         and the alert clears exactly once after BOTH windows drain —
         no flapping anywhere in between."""
-        clk = FakeClock()
+        clk = VirtualClock()
         reg = MetricsRegistry(clock=clk)
         mon = _mon(clk, reg, fast=10.0, slow=30.0)
         tr_log = []
@@ -236,7 +226,7 @@ class TestBurnRate:
         """Values hovering AT the threshold (burn 1.0) raise once and
         stay raised: clearing demands burn <= clear_ratio (0.9) in
         both windows, so threshold-hugging load cannot flap."""
-        clk = FakeClock()
+        clk = VirtualClock()
         reg = MetricsRegistry(clock=clk)
         mon = _mon(clk, reg, fast=5.0, slow=15.0, target=100.0)
         transitions = []
@@ -259,7 +249,7 @@ class TestBurnRate:
         assert [t["kind"] for t in transitions] == ["raised", "cleared"]
 
     def test_reject_rate_and_availability_metrics(self):
-        clk = FakeClock()
+        clk = VirtualClock()
         reg = MetricsRegistry(clock=clk)
         for _ in range(8):
             reg.counter("serve_accepted").inc()
@@ -280,7 +270,7 @@ class TestBurnRate:
             slo_mod.serve_window_value(reg, "nope", 60.0, clk())
 
     def test_evaluate_is_rate_limited(self):
-        clk = FakeClock()
+        clk = VirtualClock()
         reg = MetricsRegistry(clock=clk)
         mon = slo_mod.SLOMonitor(
             slo_mod.standard_targets(ttft_p99_ms=100.0), reg,
@@ -298,7 +288,7 @@ class TestBurnRate:
         otherwise-idle window is NOT a p99 breach — the windowed p99
         of one sample is that sample, and paging on it would break
         the 'single bad second never pages' contract."""
-        clk = FakeClock()
+        clk = VirtualClock()
         reg = MetricsRegistry(clock=clk)
         mon = _mon(clk, reg, fast=10.0, slow=30.0, target=500.0)
         reg.histogram("ttft_ms").observe(600.0)  # one cold request
@@ -311,7 +301,7 @@ class TestBurnRate:
         assert tr["kind"] == "raised"
 
     def test_publish_emits_standard_vocabulary(self, tmp_path):
-        clk = FakeClock()
+        clk = VirtualClock()
         reg = MetricsRegistry(clock=clk)
         mon = _mon(clk, reg, fast=5.0, slow=10.0)
         t = Tracer(tmp_path / "telemetry.jsonl", run="slo_t", proc=0)
@@ -673,7 +663,7 @@ class TestTickProfiler:
     def test_snapshot_dominates_and_derives_other(self):
         from hyperion_tpu.obs.tickprof import TickProfiler
 
-        clk = FakeClock()
+        clk = VirtualClock()
         tp = TickProfiler(wall=clk)
         for i in range(4):
             tp.record(i, {"device": 0.006, "journal": 0.002}, 0.010)
@@ -688,7 +678,7 @@ class TestTickProfiler:
     def test_window_cut_and_tail_bound(self):
         from hyperion_tpu.obs.tickprof import TickProfiler
 
-        clk = FakeClock()
+        clk = VirtualClock()
         tp = TickProfiler(capacity=8, wall=clk)
         for i in range(20):
             tp.record(i, {"slo": 0.001}, 0.001)
