@@ -29,9 +29,13 @@ Secondary rows riding the same line: `extra` (GPT-2 LM train-step
 throughput), `input_pipeline` (host batch-assembly rate, sync vs
 background-prefetched), `serving` (the continuous-batching engine
 under a seeded Poisson load — tokens/sec, TTFT p50/p99, reject rate;
-serve/loadgen.py), and `serving_scale` (`hyperion route` at 1 vs 2
+serve/loadgen.py), `serving_scale` (`hyperion route` at 1 vs 2
 replicas over the real socket wire — aggregate tokens/sec, scaleup,
-per-replica fairness, affinity hit rate; serve/router.py). The
+per-replica fairness, affinity hit rate; serve/router.py), `fleet_sim`
+(the discrete-event fleet simulator's scenario metrics;
+serve/simulate.py), and `decode_attention` (gather vs Pallas
+paged-attention decode read on a pinned geometry — tokens/sec each
+way, recompiles zero-pinned; ops/pallas/paged_attention.py). The
 chip-free rows are attached to failure lines too and
 `obs diff --history` tracks them across BENCH_r*.json.
 
@@ -94,6 +98,18 @@ PROBE_RETRIES = int(os.environ.get("HYPERION_BENCH_PROBE_RETRIES", "2"))
 # clamped blind attempt + cpu sanity) under ~1000s. The capture
 # script, which knows its own 1800s budget, raises this via env.
 DEADLINE_S = int(os.environ.get("HYPERION_BENCH_DEADLINE", "1000"))
+
+# Canonical gate vocabulary of the decode_attention probe row: every
+# name here is PROMISED to `obs diff` (scripts/check_diff_gates.py
+# fails tier-1 if one is not gated in obs/diff.py METRICS, and the
+# child stamps these names directly like the fleet_sim row). Kept at
+# module top level — bench.py's top-level imports are jax-free, so the
+# drift guard can import this without touching a backend.
+DECODE_ATTN_REPORT_KEYS = (
+    "decode_attn_tokens_per_s",          # pallas paged kernel (higher)
+    "decode_attn_gather_tokens_per_s",   # gather reference (higher)
+    "decode_attn_recompiles",            # jit growth under churn (0-pinned)
+)
 
 
 def _chained_matmul_tflops(n: int, k1: int, k2: int):
@@ -563,6 +579,93 @@ def _child_fleet_sim() -> None:
     print(json.dumps(row))
 
 
+def _child_decode_attention() -> None:
+    """Paged decode-attention probe: the gather path vs the Pallas
+    block-table-walk kernel (ops/pallas/paged_attention) at a pinned
+    (slots, MB, block_size) decode geometry, with block tables and
+    base depths CHURNING across timed calls — the serve engine's
+    steady state, and the retrace trap a naive kernel falls into.
+    Reports throughput for both paths plus the jit-cache growth across
+    the churn (`decode_attn_recompiles`, zero-pinned: table contents
+    are runtime data, one executable must serve them all). Chip-free
+    (the parent forces JAX_PLATFORMS=cpu; the kernel interprets
+    off-TPU), so the row rides success AND failure lines. NOTE: on the
+    host backend the kernel runs under the Pallas INTERPRETER, so
+    `decode_attn_speedup` < 1 is expected and informational — the
+    gather/pallas numbers are each gated against their own history,
+    never against each other."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hyperion_tpu.models.llama import _grouped_cache_attention
+    from hyperion_tpu.ops.pallas.paged_attention import (KERNEL_REV,
+                                                         paged_attention)
+
+    # pinned geometry: 4 slots, 1-token decode, GQA rep 2, 8x16 tables
+    S, T, H, Hkv, D = 4, 1, 4, 2, 64
+    bs, MB = 16, 8
+    rep, L = H // Hkv, MB * bs
+    NB = S * MB + 1  # pool incl. the null block
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (S, T, H, D), jnp.float32)
+    kp = jax.random.normal(ks[1], (NB, bs, Hkv, D), jnp.float32)
+    vp = jax.random.normal(ks[2], (NB, bs, Hkv, D), jnp.float32)
+
+    @jax.jit
+    def gather(q, kp, vp, bt, base):
+        # the llama.py gather read, verbatim shape-for-shape
+        vk = kp[bt].reshape(S, L, Hkv, D)
+        vv = vp[bt].reshape(S, L, Hkv, D)
+        kv_pos = jax.lax.broadcasted_iota(jnp.int32, (T, L), 1)
+        q_pos = base[:, None, None] + \
+            jax.lax.broadcasted_iota(jnp.int32, (T, L), 0)[None]
+        return _grouped_cache_attention(q, vk, vv, kv_pos[None] <= q_pos,
+                                        rep)
+
+    pallas = jax.jit(paged_attention)
+
+    def tables(seed: int):
+        rng = np.random.default_rng(seed)
+        bt = np.zeros((S, MB), np.int32)
+        base = rng.integers(bs, L - T, S).astype(np.int32)
+        for b in range(S):
+            nmapped = (int(base[b]) + T + bs - 1) // bs
+            bt[b, :nmapped] = rng.permutation(np.arange(1, NB))[:nmapped]
+        return jnp.asarray(bt), jnp.asarray(base)
+
+    variants = [tables(i) for i in range(8)]
+    bt0, base0 = variants[0]
+    ref = jax.block_until_ready(gather(q, kp, vp, bt0, base0))
+    out = jax.block_until_ready(pallas(q, kp, vp, bt0, base0))
+    err = float(jnp.max(jnp.abs(ref - out)))
+    warm = pallas._cache_size()
+
+    def rate(fn, iters: int = 24) -> float:
+        t0 = time.perf_counter()
+        for i in range(iters):
+            bt, base = variants[i % len(variants)]
+            jax.block_until_ready(fn(q, kp, vp, bt, base))
+        return S * T * iters / (time.perf_counter() - t0)
+
+    g = rate(gather)
+    p = rate(pallas)
+    print(json.dumps({
+        "decode_attn_tokens_per_s": round(p, 1),
+        "decode_attn_gather_tokens_per_s": round(g, 1),
+        "decode_attn_recompiles": int(pallas._cache_size() - warm),
+        "decode_attn_speedup": round(p / g, 3) if g else None,
+        "decode_attn_max_abs_err": err,
+        "kernel_rev": KERNEL_REV,
+        "interpret": jax.default_backend() != "tpu",
+        "platform": jax.default_backend(),
+        "geometry": {"slots": S, "window": T, "mb": MB, "block_size": bs,
+                     "heads": H, "kv_heads": Hkv, "head_dim": D},
+    }))
+
+
 def _child_cpu_sanity() -> None:
     """The SAME measurement harness on the host CPU backend at small N.
     When the live value is 0.0 this row proves the harness itself works
@@ -768,6 +871,28 @@ def _add_fleet_sim(out: dict, hb, tracer, remaining) -> None:
                      "sim_failover_gap_p99_ms"))
 
 
+def _add_decode_attention(out: dict, hb, tracer, remaining) -> None:
+    """Attach the paged decode-attention probe row
+    (`--child-decode-attention`): gather vs pallas block-walk kernel
+    under table churn. One tiny jit pair on the host backend — cheap,
+    so it rides success AND failure lines next to fleet_sim."""
+    if remaining() < 45:
+        out["decode_attention"] = {"error": "deadline reached; skipped"}
+        tracer.event("deadline", where="decode_attention",
+                     remaining_s=round(remaining(), 1))
+        return
+    hb.pulse(phase="decode_attention")
+    da, derr = _run_child(
+        "--child-decode-attention", int(min(120, remaining() - 15)),
+        env={"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+    )
+    out["decode_attention"] = da if da is not None else {"error": derr}
+    tracer.event("decode_attention", ok=da is not None, error=derr or None,
+                 tokens_per_s=(da or {}).get("decode_attn_tokens_per_s"),
+                 recompiles=(da or {}).get("decode_attn_recompiles"),
+                 speedup=(da or {}).get("decode_attn_speedup"))
+
+
 def main() -> None:
     import time
 
@@ -942,6 +1067,7 @@ def main() -> None:
             )
         _add_input_pipeline(out, hb, tracer, remaining)
         _add_fleet_sim(out, hb, tracer, remaining)
+        _add_decode_attention(out, hb, tracer, remaining)
         _add_serving(out, hb, tracer, remaining)
         _add_serving_scale(out, hb, tracer, remaining)
         tracer.event("publish", value=0.0, failed=True, error=err)
@@ -999,6 +1125,7 @@ def main() -> None:
         out["extra"] = {"error": "deadline reached; skipped"}
     _add_input_pipeline(out, hb, tracer, remaining)
     _add_fleet_sim(out, hb, tracer, remaining)
+    _add_decode_attention(out, hb, tracer, remaining)
     _add_serving(out, hb, tracer, remaining)
     _add_serving_scale(out, hb, tracer, remaining)
     tracer.event("publish", value=out["value"], plausible=plausible,
@@ -1023,6 +1150,8 @@ if __name__ == "__main__":
         _child_serving_scale()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child-fleet-sim":
         _child_fleet_sim()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--child-decode-attention":
+        _child_decode_attention()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child-cpu-sanity":
         _child_cpu_sanity()
     else:

@@ -50,6 +50,14 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     attention_impl: str = "xla"
     norm_impl: str = "xla"        # xla | pallas (fused_rmsnorm kernel)
+    # Decode-time paged-cache read strategy. "gather" materializes
+    # pool[block_tables] into a contiguous [B, L, Hkv, D] view every
+    # tick (an HBM copy of the whole mapped chain per token); "pallas"
+    # routes through ops.pallas.paged_attention, which walks the block
+    # table in-kernel and reads the pools in place. Identical masking
+    # contract; pinned-tolerance numerics (online softmax — see the
+    # kernel docstring). Ignored outside the paged (block_tables) path.
+    paged_attn_impl: str = "gather"
     # "none" | "int8": weight-only int8 inference (precision/quant.py) —
     # dense kernels become int8+scale (half bf16's HBM traffic, int8
     # MXU matmuls); params come from quantize_params_for() on a trained
@@ -234,9 +242,12 @@ class LlamaAttention(nn.Module):
         pooled layout {'k','v': [num_blocks, block_size, Hkv, D]}
         (`init_paged_cache`): logical position p of row b lives at
         physical block `block_tables[b, p // bs]`, offset `p % bs`.
-        Writes scatter through the table; reads gather each row's
-        blocks back into a contiguous [B, MB*bs] view and run the same
-        masked grouped attention. Out-of-range or unmapped positions
+        Writes scatter through the table; reads either gather each
+        row's blocks back into a contiguous [B, MB*bs] view for the
+        same masked grouped attention (`paged_attn_impl="gather"`) or
+        walk the table in-kernel against the pools in place
+        (`"pallas"`, ops.pallas.paged_attention — no contiguous copy).
+        Out-of-range or unmapped positions
         route to physical block 0 (the serve engine's null block), so
         bucket padding can never corrupt a neighbour's blocks.
 
@@ -278,16 +289,30 @@ class LlamaAttention(nn.Module):
             off = cols % bs
             ck = cache["k"].at[phys, off].set(k.astype(cache["k"].dtype))
             cv = cache["v"].at[phys, off].set(v.astype(cache["v"].dtype))
-            # gather each row's chain into the contiguous view the
-            # grouped attention expects; rows beyond a row's frontier
-            # are masked off exactly as in the slab layout
-            vk = ck[block_tables].reshape(B, L, ck.shape[2], ck.shape[3])
-            vv = cv[block_tables].reshape(B, L, cv.shape[2], cv.shape[3])
-            kv_pos = jax.lax.broadcasted_iota(jnp.int32, (T, L), 1)
-            q_pos = base[:, None, None] + \
-                jax.lax.broadcasted_iota(jnp.int32, (T, L), 0)[None]
-            mask = kv_pos[None] <= q_pos  # [B, T, L]
-            out = _grouped_cache_attention(q, vk, vv, mask, rep)
+            if c.paged_attn_impl == "pallas":
+                # read the pools in place: the kernel walks the block
+                # table itself, so no contiguous copy is materialized
+                from hyperion_tpu.ops.pallas.paged_attention import (
+                    paged_attention,
+                )
+
+                out = paged_attention(q, ck, cv, block_tables, base)
+            elif c.paged_attn_impl == "gather":
+                # gather each row's chain into the contiguous view the
+                # grouped attention expects; rows beyond a row's
+                # frontier are masked off exactly as in the slab layout
+                vk = ck[block_tables].reshape(B, L, ck.shape[2], ck.shape[3])
+                vv = cv[block_tables].reshape(B, L, cv.shape[2], cv.shape[3])
+                kv_pos = jax.lax.broadcasted_iota(jnp.int32, (T, L), 1)
+                q_pos = base[:, None, None] + \
+                    jax.lax.broadcasted_iota(jnp.int32, (T, L), 0)[None]
+                mask = kv_pos[None] <= q_pos  # [B, T, L]
+                out = _grouped_cache_attention(q, vk, vv, mask, rep)
+            else:
+                raise ValueError(
+                    f"unknown paged_attn_impl {c.paged_attn_impl!r} "
+                    "(want 'gather' or 'pallas')"
+                )
             return dense(
                 features=c.d_model, axis=(-2, -1), name="o_proj"
             )(out), {"k": ck, "v": cv}

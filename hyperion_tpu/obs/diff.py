@@ -134,6 +134,17 @@ METRICS: dict[str, str] = {
     "sim_failover_gap_p99_ms": "lower",
     "sim_failover_steer_reversals": "lower",
     "sim_failover_duplicate_tokens": "lower",
+    # paged decode-attention probe (PR 19, ops/pallas/paged_attention
+    # via the bench decode_attention row): gather and pallas kernel
+    # throughput each gated against their OWN history (never against
+    # each other — on the host the kernel runs interpreted and loses by
+    # design), plus jit-cache growth under block-table churn. Zero-
+    # pinned: the block table is runtime data; ONE executable must
+    # serve every table/base combination, so any recompile is a
+    # retrace bug, not a drift.
+    "decode_attn_tokens_per_s": "higher",
+    "decode_attn_gather_tokens_per_s": "higher",
+    "decode_attn_recompiles": "lower",
 }
 
 # metrics whose healthy value is exactly zero: the percent-threshold
@@ -152,7 +163,11 @@ ZERO_PINNED = frozenset({"serve_recompiles",
                          # a duplicate under virtual failover is the
                          # same dedup bug, caught cheaper
                          "sim_herd_duplicate_tokens",
-                         "sim_failover_duplicate_tokens"})
+                         "sim_failover_duplicate_tokens",
+                         # paged-attention kernel: block tables are
+                         # runtime data — a single recompile under
+                         # table churn is a retrace bug
+                         "decode_attn_recompiles"})
 
 
 def _num(v) -> float | None:
@@ -261,6 +276,17 @@ def normalize(doc: dict) -> dict[str, float]:
                 if not name.startswith("sim_"):
                     continue
                 v = _num(fsim.get(name))
+                if v is not None:
+                    out[name] = v
+        # bench decode_attention probe (ops/pallas/paged_attention):
+        # like fleet_sim, the child stamps canonical decode_attn_*
+        # names directly — keep the ones the gate vocabulary knows
+        dattn = doc.get("decode_attention")
+        if isinstance(dattn, dict):
+            for name in METRICS:
+                if not name.startswith("decode_attn_"):
+                    continue
+                v = _num(dattn.get(name))
                 if v is not None:
                     out[name] = v
     # trainer *_summary.json {"step_ms": ..., "peak_hbm_mb": ...}

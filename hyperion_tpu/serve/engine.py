@@ -1499,10 +1499,18 @@ class Engine:
         arithmetic (`.nbytes` is metadata, `_block_bytes` a cached
         int), so any thread may ask."""
         bb = self._block_bytes
+        # HBM the paged-read strategy copies per decode tick: the
+        # gather path materializes every slot's full [MB] chain
+        # (mapped or null) into a contiguous view; the pallas kernel
+        # reads the pools in place, so the copy is zero.
+        impl = getattr(self.model.cfg, "paged_attn_impl", "gather")
+        gather = 0 if impl == "pallas" else \
+            int(self.cfg.slots * self._mb * bb)
         return {
             "param_bytes": self._param_bytes,
             "kv_pool_bytes": int(self.cfg.num_blocks * bb),
             "blocks_in_use_bytes": int(self.mgr.in_use * bb),
+            "kv_gather_bytes_per_tick": gather,
             "rss_mb": hb_host_rss_mb(),
         }
 

@@ -461,6 +461,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "prefix-sharing opportunities, larger = smaller "
                         "block tables; need not divide max_len (the "
                         "table rounds up to whole blocks)")
+    p.add_argument("--paged-attn", choices=("gather", "pallas"),
+                   default="gather",
+                   help="paged-cache read strategy: 'gather' copies "
+                        "each slot's whole block chain into a "
+                        "contiguous view every tick; 'pallas' walks "
+                        "the block table in-kernel and reads the KV "
+                        "pools in place (ops/pallas/paged_attention, "
+                        "interpret-mode off-TPU). Streams stay "
+                        "deterministic; memory ledger shows the saved "
+                        "copy as kv_gather_bytes_per_tick=0")
     p.add_argument("--num-blocks", type=int, default=0,
                    help="KV pool size in blocks incl. the null block "
                         "(0 = auto: slots x ceil(max_len/block_size) + 1, "
@@ -758,6 +768,15 @@ def main(argv=None) -> int:
               "to batch over", file=sys.stderr)
         tracer.close()
         return 2
+
+    if args.paged_attn != "gather":
+        # same architecture + params, different paged-read strategy —
+        # a config-only swap, so every engine jit keeps its signature
+        import dataclasses as _dc
+
+        from hyperion_tpu.models.llama import Llama
+
+        model = Llama(_dc.replace(model.cfg, paged_attn_impl=args.paged_attn))
 
     eos_id = args.eos_id
     if eos_id is None and tok is not None:

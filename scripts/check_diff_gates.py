@@ -45,6 +45,26 @@ SERVING_SCALE_KEYS = ("tokens_per_s", "scaleup", "fairness",
                       "router_overhead_p99_ms", "failover_gap_p99_ms")
 
 
+def _bench_decode_attn_keys() -> tuple[str, ...]:
+    """`DECODE_ATTN_REPORT_KEYS` straight from bench.py — the probe's
+    own promised gate vocabulary. bench.py is not a package module, so
+    importlib loads it by path; its top-level imports are jax-free
+    (the same property tests/test_bench_cli.py leans on), so this
+    stays a host-only check."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench.py")
+    spec = importlib.util.spec_from_file_location("_bench_gates", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return tuple(mod.DECODE_ATTN_REPORT_KEYS)
+
+
+DECODE_ATTN_KEYS = _bench_decode_attn_keys()
+
+
 def synthetic_doc() -> dict:
     """One document exercising every `normalize()` surface with the
     keys the real emitters write."""
@@ -66,6 +86,10 @@ def synthetic_doc() -> dict:
         "fleet_sim": {diff_key(scn, k): 1.0
                       for scn, keys in DIFF_GATED.items()
                       for k in keys},
+        # bench decode_attention probe row: built from bench.py's own
+        # DECODE_ATTN_REPORT_KEYS, so a key rename there orphans the
+        # diff.py gate loudly
+        "decode_attention": {k: 1.0 for k in DECODE_ATTN_KEYS},
         # trainer *_summary.json
         "step_ms": 1.0, "peak_hbm_mb": 1.0,
     }
@@ -86,14 +110,25 @@ def ungated_sim_keys() -> list[str]:
     return sorted(promised - set(METRICS))
 
 
+def ungated_decode_attn_keys() -> list[str]:
+    """bench.py DECODE_ATTN_REPORT_KEYS missing from METRICS — a gate
+    the probe promises but `obs diff` never enforces (sorted)."""
+    return sorted(set(DECODE_ATTN_KEYS) - set(METRICS))
+
+
 def main(argv: list[str] | None = None) -> int:
     orphans = orphaned_gates()
     unpinned = sorted(set(ZERO_PINNED) - set(METRICS))
     ungated = ungated_sim_keys()
+    ungated_da = ungated_decode_attn_keys()
     if ungated:
         print("check_diff_gates: FAIL — simulate.DIFF_GATED name(s) "
               f"not gated in obs/diff.py METRICS: {', '.join(ungated)}",
               file=sys.stderr)
+    if ungated_da:
+        print("check_diff_gates: FAIL — bench.py "
+              "DECODE_ATTN_REPORT_KEYS name(s) not gated in obs/diff.py "
+              f"METRICS: {', '.join(ungated_da)}", file=sys.stderr)
     if orphans:
         print("check_diff_gates: FAIL — gated but unproducible "
               f"metric(s): {', '.join(orphans)} — the emitter key was "
@@ -102,7 +137,7 @@ def main(argv: list[str] | None = None) -> int:
     if unpinned:
         print("check_diff_gates: FAIL — ZERO_PINNED name(s) not in "
               f"METRICS: {', '.join(unpinned)}", file=sys.stderr)
-    if orphans or unpinned or ungated:
+    if orphans or unpinned or ungated or ungated_da:
         return 1
     print(f"check_diff_gates: OK — {len(METRICS)} gated metric(s), "
           "all producible from emitter vocabularies")

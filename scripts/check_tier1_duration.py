@@ -1,10 +1,12 @@
 #!/usr/bin/env python
 """Tier-1 wall-time guard.
 
-Tier-1 must finish inside its 870s timeout with headroom — a suite
-that creeps past ~850s is one slow test away from the timeout killing
+Tier-1 must finish inside its 900s timeout with headroom — a suite
+that creeps past ~880s is one slow test away from the timeout killing
 the run mid-suite, which reads as a mass failure instead of the real
-regression. This guard parses the pytest summary line out of the
+regression. (The budget grew 850→880 alongside the PR-19 paged-
+attention tests: the Pallas interpreter re-traces per eager call, so
+its op/model/serve oracles add real seconds that belong in tier-1.) This guard parses the pytest summary line out of the
 tier-1 log (`tee /tmp/_t1.log` in the ROADMAP verify command, run
 with `--durations=15` so the log also names the offenders) and fails
 when the suite's own reported wall time exceeds the budget.
@@ -28,7 +30,7 @@ from __future__ import annotations
 import re
 import sys
 
-DEFAULT_BUDGET_S = 850.0
+DEFAULT_BUDGET_S = 880.0
 
 # pytest's final summary: "=== 1014 passed, 3 skipped in 782.41s (0:13:02) ==="
 _SUMMARY = re.compile(r"^=+ .*\bin (\d+(?:\.\d+)?)s(?: \([0-9:]+\))? =+")

@@ -857,4 +857,43 @@ print(f"[serve_smoke] OK: fleet trace — {len(evs)} events across "
       f"{len(pids)} process tracks, {len(starts & ends)} flow arrow(s)")
 PY
 
+# 12. paged-attention kernel round trip: leg 6's request decoded again
+#     with --paged-attn pallas (the in-kernel block-table walk; the
+#     kernel interprets on this host backend) — the client stream must
+#     be bit-identical to the sequential gather reference
+#     (ref_responses.jsonl), and the serve_end flight record's memory
+#     ledger must show the per-tick gather copy GONE
+#     (kv_gather_bytes_per_tick == 0)
+printf '%s\n' "$KILLREQ" \
+  | env HYPERION_TELEMETRY="$WORK/pa_tele.jsonl" \
+    python -m hyperion_tpu.cli.main serve \
+      --ckpt "$WORK/llama.npz" --no-tokenizer \
+      --max-len 64 --slots 2 --warmup-lens 8,32 \
+      --paged-attn pallas \
+      > "$WORK/pa_responses.jsonl"
+
+python - "$WORK/ref_responses.jsonl" "$WORK/pa_responses.jsonl" \
+         "$WORK/flight.json" <<'PY'
+import json
+import sys
+
+
+def stream(path):
+    return [rec["token"] for rec in map(json.loads, open(path))
+            if rec.get("id") == "k1" and rec.get("event") == "token"
+            and rec.get("token") is not None]
+
+
+ref, got = stream(sys.argv[1]), stream(sys.argv[2])
+assert len(ref) == 10 and got == ref, (
+    f"pallas paged-attn stream diverges from gather: {got} != {ref}")
+flight = json.load(open(sys.argv[3]))
+gather_bytes = flight["memory"]["kv_gather_bytes_per_tick"]
+assert gather_bytes == 0, (
+    f"kernel run still reports a gather copy: {gather_bytes} B/tick")
+print(f"[serve_smoke] OK: paged-attn kernel round trip — {len(got)} "
+      "tokens bit-identical to the gather run, "
+      "kv_gather_bytes_per_tick=0 on the flight record")
+PY
+
 echo "[serve_smoke] all legs passed"

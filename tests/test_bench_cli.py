@@ -86,6 +86,12 @@ GOOD_FLEET_SIM = {"sim_herd_shed_rate": 0.2,
                   "sim_failover_steer_reversals": 0.0,
                   "sim_failover_duplicate_tokens": 0.0,
                   "sim_failover_ok": True, "sim_failover_wall_s": 3.0}
+GOOD_DECODE_ATTN = {"decode_attn_tokens_per_s": 1500.0,
+                    "decode_attn_gather_tokens_per_s": 23000.0,
+                    "decode_attn_recompiles": 0,
+                    "decode_attn_speedup": 0.065,
+                    "decode_attn_max_abs_err": 1.3e-07,
+                    "kernel_rev": 1}
 GOOD_MEASUREMENT = {
     "tflops": 150.0, "per_iter_ms": 7.0, "amortized_ms": 7.0,
     "dispatch_overhead_ms": 60.0, "chain_lengths": [16, 48],
@@ -122,6 +128,7 @@ class TestBenchMain:
             "--child-serving": (30, GOOD_SERVING, ""),
             "--child-serving-scale": (40, GOOD_SCALE, ""),
             "--child-fleet-sim": (10, GOOD_FLEET_SIM, ""),
+            "--child-decode-attention": (10, GOOD_DECODE_ATTN, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -138,6 +145,9 @@ class TestBenchMain:
         # the flight-simulator row rides under its canonical diff keys
         assert out["fleet_sim"]["sim_herd_completed_rate"] == 0.7
         assert out["fleet_sim"]["sim_failover_duplicate_tokens"] == 0.0
+        # the paged-attention probe row too, canonical names included
+        assert out["decode_attention"]["decode_attn_tokens_per_s"] == 1500.0
+        assert out["decode_attention"]["decode_attn_recompiles"] == 0
 
     def test_dead_tunnel_emits_failure_with_sanity(self, bench, clock,
                                                    capsys, monkeypatch):
@@ -151,6 +161,7 @@ class TestBenchMain:
             "--child-serving": (30, GOOD_SERVING, ""),
             "--child-serving-scale": (40, GOOD_SCALE, ""),
             "--child-fleet-sim": (10, GOOD_FLEET_SIM, ""),
+            "--child-decode-attention": (10, GOOD_DECODE_ATTN, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -165,6 +176,7 @@ class TestBenchMain:
         assert "input_pipeline" in out
         assert "serving" in out
         assert "serving_scale" in out
+        assert "decode_attention" in out
         # total simulated wall time stayed inside the deadline
         assert clock.t - 1000.0 <= bench.DEADLINE_S
 
@@ -179,6 +191,7 @@ class TestBenchMain:
             "--child-serving": (30, GOOD_SERVING, ""),
             "--child-serving-scale": (40, GOOD_SCALE, ""),
             "--child-fleet-sim": (10, GOOD_FLEET_SIM, ""),
+            "--child-decode-attention": (10, GOOD_DECODE_ATTN, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -222,6 +235,7 @@ class TestBenchMain:
             "--child-serving": (30, GOOD_SERVING, ""),
             "--child-serving-scale": (40, GOOD_SCALE, ""),
             "--child-fleet-sim": (10, GOOD_FLEET_SIM, ""),
+            "--child-decode-attention": (10, GOOD_DECODE_ATTN, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
@@ -231,7 +245,8 @@ class TestBenchMain:
         assert names[0] == "bench_start"
         for expected in ("probe_attempt", "probe_result",
                          "measure_attempt", "measure_result",
-                         "input_pipeline", "fleet_sim", "serving",
+                         "input_pipeline", "fleet_sim",
+                         "decode_attention", "serving",
                          "publish"):
             assert expected in names, names
         publish = [json.loads(line)
@@ -251,6 +266,7 @@ class TestBenchMain:
             "--child-serving": (10_000, None, ""),
             "--child-serving-scale": (10_000, None, ""),
             "--child-fleet-sim": (10_000, None, ""),
+            "--child-decode-attention": (10_000, None, ""),
         })
         monkeypatch.setattr(bench, "_run_child", runner)
         out = run_main(bench, capsys)
