@@ -425,6 +425,45 @@ def _child_serving() -> None:
     for key in ("interactive_ttft_p99_ms", "batch_shed_rate",
                 "interactive_shed", "batch_shed"):
         report[key] = r.get(key)
+
+    # ---- the @rehit dimension: the tiered-KV drill as a bench point —
+    # the SAME seeded shared-prefix workload with a middle churn of
+    # distinct long prompts sized to evict the shared chain from a
+    # deliberately small device pool, run host tier OFF (the re-hit
+    # re-prefills from scratch) and ON (the re-hit restores evicted
+    # blocks from host RAM). The ON point's tier keys ride the row
+    # TOP-LEVEL: they are what `serve_tier_hit_rate_host` /
+    # `serve_restore_bytes_per_s` gate, measured where eviction
+    # actually happens; the OFF point pins the re-prefill baseline the
+    # `serve_prefill_tokens_saved` delta is judged against.
+    rehit_load = LoadSpec(n_requests=24, rate_hz=100.0,
+                          prompt_lens=(4, 8, 16), max_new=(4, 8, 12),
+                          vocab=cfg.vocab_size, seed=0,
+                          shared_prefix_tokens=shared,
+                          rehit_churn=8)
+    report["rehit"] = {}
+    for label, mb in (("off", 0), ("host", 8)):
+        eng = Engine(
+            model, {"params": params},
+            EngineConfig(slots=4, max_len=128, eos_id=None,
+                         queue_capacity=8, prefill_budget=96,
+                         num_blocks=48, host_cache_mb=mb),
+        )
+        eng.warmup([shared + p for p in rehit_load.prompt_lens])
+        r = run_load(eng, rehit_load)
+        report["rehit"][label] = {
+            key: r.get(key)
+            for key in ("tokens_per_s", "completed", "prefix_hit_rate",
+                        "prefill_tokens_saved", "tier_hits_device",
+                        "tier_hits_host", "tier_miss",
+                        "tier_hit_rate_host", "restore_bytes_per_s",
+                        "host_cache_mb", "recompiles")
+        }
+        if label == "host":
+            for key in ("tier_hits_device", "tier_hits_host",
+                        "tier_miss", "tier_hit_rate_host",
+                        "restore_bytes_per_s", "host_cache_mb"):
+                report[key] = r.get(key)
     print(json.dumps(report))
 
 
@@ -816,7 +855,10 @@ def _add_serving(out: dict, hb, tracer, remaining) -> None:
                  dominant_phase_p99=(srv or {}).get("dominant_phase_p99"),
                  ttft_p99_ms=(srv or {}).get("ttft_p99_ms"),
                  # SLO plane: a probe round that fired alerts says so
-                 alerts_raised=(srv or {}).get("alerts_raised"))
+                 alerts_raised=(srv or {}).get("alerts_raised"),
+                 # tiered KV cache (@rehit dimension): the host-tier
+                 # hit rate the round measured under forced eviction
+                 tier_hit_rate_host=(srv or {}).get("tier_hit_rate_host"))
 
 
 def _add_serving_scale(out: dict, hb, tracer, remaining) -> None:

@@ -145,6 +145,16 @@ METRICS: dict[str, str] = {
     "decode_attn_tokens_per_s": "higher",
     "decode_attn_gather_tokens_per_s": "higher",
     "decode_attn_recompiles": "lower",
+    # tiered KV cache (PR 20, serve/hostcache.py via the bench serving
+    # row's @rehit dimension): the host spill tier's whole value is
+    # prefill work NOT redone after eviction — its hit rate or restore
+    # bandwidth falling, or the prefill tokens the caches saved
+    # falling, means evicted prefixes are being recomputed again.
+    # `scripts/check_diff_gates.py` cross-checks these against
+    # hostcache.TIER_GATED so the promise and the gate can never drift.
+    "serve_tier_hit_rate_host": "higher",
+    "serve_restore_bytes_per_s": "higher",
+    "serve_prefill_tokens_saved": "higher",
 }
 
 # metrics whose healthy value is exactly zero: the percent-threshold
@@ -247,7 +257,13 @@ def normalize(doc: dict) -> dict[str, float]:
                               ("interactive_ttft_p99_ms",
                                "serve_interactive_ttft_p99_ms"),
                               ("batch_shed_rate",
-                               "serve_batch_shed_rate")):
+                               "serve_batch_shed_rate"),
+                              ("tier_hit_rate_host",
+                               "serve_tier_hit_rate_host"),
+                              ("restore_bytes_per_s",
+                               "serve_restore_bytes_per_s"),
+                              ("prefill_tokens_saved",
+                               "serve_prefill_tokens_saved")):
                 v = _num(srv.get(src))
                 if v is not None:
                     out[name] = v

@@ -328,6 +328,17 @@ def diagnose(
                 "prefix_hit_rate": g.get("serve_prefix_hit_rate"),
                 "blocks_in_use": g.get("serve_blocks_in_use"),
                 "hbm_per_req_mb": g.get("serve_hbm_per_req_mb"),
+                # tiered KV cache (PR 20, serve/hostcache.py): where
+                # prefix lookups landed and what the host tier moved
+                "blocks_evicted": c.get("serve_blocks_evicted"),
+                "tier_hits_device": c.get("serve_tier_hits_device"),
+                "tier_hits_host": c.get("serve_tier_hits_host"),
+                "tier_miss": c.get("serve_tier_miss"),
+                "tier_hit_rate_host": g.get("serve_tier_hit_rate_host"),
+                "host_spilled_blocks": c.get("serve_host_spilled_blocks"),
+                "host_restored_blocks":
+                    c.get("serve_host_restored_blocks"),
+                "host_cache_mb": g.get("serve_host_cache_mb"),
                 # crash safety + overload (serve/journal.py, brownout)
                 "shed": c.get("serve_shed"),
                 "brownout_clamped": c.get("serve_brownout_clamped"),
@@ -503,6 +514,53 @@ def diagnose(
     if cache_pressure and verdict in ("healthy", "running", "stalled",
                                       "failed"):
         reason += "; cache pressure: " + "; ".join(cache_pressure)
+
+    # Cache-TIER incidents (PR 20, serve/hostcache.py): device
+    # evictions are survivable exactly when the host spill tier
+    # catches them. The serve_start header says whether the tier was
+    # ON (--host-cache-mb), `host_restore` events say it actually fed
+    # re-hits, and `hostcache_saved` / `hostcache_loaded` events prove
+    # the store survived a drain/restart cycle — so "disabled" and
+    # "undersized" are DIFFERENT named incidents with different knobs.
+    tier_incidents: list[str] = []
+    start_ev = next((e for e in reversed(events)
+                     if e.get("name") == "serve_start"), None)
+    tier_mb = (start_ev or {}).get("host_cache_mb")
+    restore_events = sum(1 for e in events
+                         if e.get("name") == "host_restore")
+    saved_ev = next((e for e in reversed(events)
+                     if e.get("name") == "hostcache_saved"), None)
+    loaded_ev = next((e for e in reversed(events)
+                      if e.get("name") == "hostcache_loaded"), None)
+    evicted = int((serve or {}).get("blocks_evicted") or 0)
+    spilled = int((serve or {}).get("host_spilled_blocks") or 0)
+    host_hits = int((serve or {}).get("tier_hits_host") or 0)
+    if evicted and tier_mb is not None and not tier_mb:
+        tier_incidents.append(
+            f"{evicted} KV block(s) evicted with the host tier "
+            "DISABLED — evicted prefixes re-prefill from scratch on "
+            "re-hit; set --host-cache-mb to spill them to host RAM")
+    elif tier_mb and spilled and not host_hits \
+            and int((serve or {}).get("tier_miss") or 0):
+        tier_incidents.append(
+            f"host tier spilled {spilled} block(s) but fed ZERO "
+            "re-hits while prefix lookups still missed — "
+            "--host-cache-mb likely undersized (spilled chains "
+            "LRU-evicted before the workload came back for them)")
+    host_tier = None
+    if tier_mb or spilled or restore_events or saved_ev or loaded_ev:
+        host_tier = {
+            "budget_mb": tier_mb,
+            "restore_events": restore_events,
+            "saved": ({"chains": saved_ev.get("chains"),
+                       "mb": saved_ev.get("mb")} if saved_ev else None),
+            "loaded": ({"chains": loaded_ev.get("chains"),
+                        "mb": loaded_ev.get("mb")} if loaded_ev
+                       else None),
+        }
+    if tier_incidents and verdict in ("healthy", "running", "stalled",
+                                      "failed"):
+        reason += "; cache tier: " + "; ".join(tier_incidents)
 
     # Low-acceptance speculation incident (spec-enabled runs only): when
     # drafts mostly miss, every decode tick still pays the k+1-wide
@@ -970,6 +1028,10 @@ def diagnose(
         # assertion verdict from a discrete-event fleet run
         "sim": sim,
         "cache_pressure": cache_pressure,
+        # tiered KV cache (serve/hostcache.py): spill-tier evidence
+        # and the disabled-vs-undersized incident split
+        "tier_incidents": tier_incidents,
+        "host_tier": host_tier,
         "spec_incidents": spec_issues,
         "overload": overload,
         "poisoned_requests": poisoned_ids,
@@ -1101,6 +1163,18 @@ def render_markdown(d: dict) -> str:
                 f"{_fmt(srv.get('prefix_hit_rate'))}, preempted "
                 f"{_fmt(srv.get('preempted'))}, HBM/req "
                 f"{_fmt(srv.get('hbm_per_req_mb'))} MB{flag} |")
+        if any(srv.get(k) for k in ("tier_hits_device", "tier_hits_host",
+                                    "tier_miss", "host_spilled_blocks")) \
+                or (d.get("host_tier") or {}).get("budget_mb"):
+            flag = " — **tier incident**" if d.get("tier_incidents") else ""
+            lines.append(
+                f"| serve cache tiers | device "
+                f"{_fmt(srv.get('tier_hits_device'))}, host "
+                f"{_fmt(srv.get('tier_hits_host'))}, miss "
+                f"{_fmt(srv.get('tier_miss'))}, spilled "
+                f"{_fmt(srv.get('host_spilled_blocks'))}, restored "
+                f"{_fmt(srv.get('host_restored_blocks'))}, host RAM "
+                f"{_fmt(srv.get('host_cache_mb'))} MB{flag} |")
         if srv.get("spec_drafted"):
             flag = " — **low acceptance**" if d.get("spec_incidents") else ""
             lines.append(

@@ -56,7 +56,12 @@ ROW_KEYS = ("name", "dir", "source", "state", "pid", "phase", "step",
             # workload isolation (PR 14): per-SLO-class queue depth and
             # what the self-operating layer is doing right now (engine:
             # class brownout / chunking; router: steering / scaling)
-            "queue_interactive", "queue_batch", "act")
+            "queue_interactive", "queue_batch", "act",
+            # tiered KV cache (PR 20, serve/hostcache.py): host-tier
+            # hit rate and host-RAM occupancy — null on a tier-off
+            # process, so the column distinguishes "disabled" from
+            # "enabled but cold"
+            "tier_hit_host", "host_cache_mb")
 
 
 def discover(base: str | Path) -> list[tuple[str, Path]]:
@@ -110,6 +115,8 @@ def _row_from_exposition(row: dict, exp: dict) -> dict:
         row["occupancy"] = gauges.get("slot_occupancy")
     if row["blocks_in_use"] is None:
         row["blocks_in_use"] = gauges.get("serve_blocks_in_use")
+    row["tier_hit_host"] = gauges.get("serve_tier_hit_rate_host")
+    row["host_cache_mb"] = gauges.get("serve_host_cache_mb")
     tp = exp.get("tickprof") or {}
     row["dominant_segment"] = tp.get("dominant")
     row["rss_mb"] = (exp.get("memory") or {}).get("rss_mb")
@@ -212,7 +219,7 @@ def render(rows: list[dict], base: str, *, window_s: float,
             ("tick", 6), ("occ", 5), ("queue", 5), ("q i/b", 6),
             ("tok/s", 8),
             (f"ttft p99({window_s:.0f}s)", 14), ("blocks", 6),
-            ("seg", 9), ("rss", 7),
+            ("tier", 9), ("seg", 9), ("rss", 7),
             ("brown", 5), ("act", 12), ("alerts", 18), ("age", 5)]
     head = " ".join(f"{n:<{w}}" for n, w in cols)
     lines = [
@@ -232,11 +239,16 @@ def render(rows: list[dict], base: str, *, window_s: float,
                and r["queue_batch"] is None
                else f"{_fmt(r['queue_interactive'])}"
                     f"/{_fmt(r['queue_batch'])}")
+        # host-tier cell: hit-rate/occupancy; "—" means the spill tier
+        # is off on this process, 0.00/0M means on-but-cold
+        tier = ("—" if r["host_cache_mb"] is None
+                else f"{_fmt(r['tier_hit_host'], 2)}"
+                     f"/{r['host_cache_mb']:.0f}M")
         cells = [r["name"], r["state"] or "?", _fmt(r["pid"]),
                  _fmt(r["phase"]), _fmt(r["step"]), occ,
                  _fmt(r["queue"]), qib,
                  _fmt(r["tokens_per_s"]), p99,
-                 _fmt(r["blocks_in_use"]),
+                 _fmt(r["blocks_in_use"]), tier,
                  _fmt(r["dominant_segment"]), rss,
                  _fmt(bool(r["brownout"])), _fmt(r["act"]),
                  ",".join(r["alerts"] or []) or "-", _fmt(r["age_s"], 0)]

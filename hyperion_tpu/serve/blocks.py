@@ -251,11 +251,18 @@ class RadixPrefixCache:
     the copy-on-write *extension*: a new prompt that diverges mid-block
     still reuses the agreeing positions via one block copy."""
 
-    def __init__(self, mgr: BlockManager):
+    def __init__(self, mgr: BlockManager, spill=None):
         self.mgr = mgr
         self.root = _Node(tokens=(), block=NULL_BLOCK, parent=None)
         self._nodes: list[_Node] = []
         self._clock = itertools.count(1)
+        # the host-tier seam (serve/hostcache.py): when set, `evict`
+        # hands each dying chain's full token key + physical block to
+        # the callback BEFORE the decref frees it — demotion instead of
+        # deletion. `clear` never spills (shutdown/tests drop holds,
+        # they don't demote), and a block some sequence still shares
+        # (refcount > 1) isn't dying, so it never spills either.
+        self.spill = spill
 
     # ------------------------------------------------------------ reads
 
@@ -356,7 +363,7 @@ class RadixPrefixCache:
                     victim = node
             if victim is None:
                 break
-            self._drop(victim)
+            self._drop(victim, spill=True)
             freed += 1
         return freed
 
@@ -378,7 +385,23 @@ class RadixPrefixCache:
                 break
         return freed
 
-    def _drop(self, node: _Node) -> None:
+    def chain_tokens(self, node: _Node) -> tuple[int, ...]:
+        """The full token prefix a node's block completes — root..node
+        inclusive, reconstructed by walking parents. This is the host
+        tier's chain key: `tokens[-block_size:]` are the node's own."""
+        parts: list[tuple[int, ...]] = []
+        n: _Node | None = node
+        while n is not None and n.tokens:
+            parts.append(n.tokens)
+            n = n.parent
+        return tuple(t for chunk in reversed(parts) for t in chunk)
+
+    def _drop(self, node: _Node, spill: bool = False) -> None:
+        if spill and self.spill is not None \
+                and self.mgr.refcount(node.block) == 1:
+            # the block's K/V still sit in the device pool until the
+            # decref below recycles it — spill reads them out NOW
+            self.spill(self.chain_tokens(node), node.block)
         parent = node.parent
         if parent is not None:
             parent.children.pop(node.tokens, None)

@@ -85,6 +85,11 @@ from hyperion_tpu.serve.blocks import (
     SeqAlloc,
     blocks_for,
 )
+from hyperion_tpu.serve.hostcache import (
+    HostBlockStore,
+    HotRootTracker,
+    prefix_root_digest,
+)
 from hyperion_tpu.obs import slo as slo_mod
 from hyperion_tpu.obs.export import DEFAULT_WINDOW_S
 from hyperion_tpu.obs.heartbeat import host_rss_mb as hb_host_rss_mb
@@ -336,6 +341,16 @@ class EngineConfig:
     num_blocks: int = 0            # pool size incl. null block (0 = auto:
     #                                slots * ceil(L/bs) + 1, the slab equivalent)
     prefix_cache: bool = True      # radix prefix reuse on/off
+    # ---- tiered KV (serve/hostcache.py) ----
+    # > 0 enables the host-RAM spill tier: radix eviction demotes cold
+    # prefix chains to host numpy buffers under this LRU budget, and a
+    # later same-prefix admission restores them with one H2D scatter
+    # per block instead of a re-prefill. Needs the prefix cache on.
+    host_cache_mb: int = 0
+    # where the store serializes on drain (empty = no persistence):
+    # a spilled chain outlives the process, riding the journal's
+    # recovery path — restart between evict and rehit still restores
+    host_cache_dir: str = ""
     # "reserve": a request only admits when its WORST-CASE block demand
     # (prompt + full budget, minus shared prefix) is covered — pool
     # exhaustion is impossible by accounting. "optimistic": admit on
@@ -521,6 +536,25 @@ class Engine:
         self._seqs: list[SeqAlloc | None] = [None] * cfg.slots
         self.mgr = BlockManager(num_blocks, bs)
         self.prefix = RadixPrefixCache(self.mgr) if cfg.prefix_cache else None
+        # tiered KV: the host-RAM spill tier behind the radix cache
+        # (serve/hostcache.py) — eviction demotes, admission restores
+        self.host: HostBlockStore | None = None
+        self._hot_roots = HotRootTracker()
+        if cfg.host_cache_mb > 0 and self.prefix is not None:
+            self.host = HostBlockStore(cfg.host_cache_mb, bs)
+            self.prefix.spill = self._spill_block
+            if cfg.host_cache_dir:
+                n_loaded = self.host.load(cfg.host_cache_dir)
+                if n_loaded:
+                    self.tracer.event(
+                        "hostcache_loaded", chains=n_loaded,
+                        mb=round(self.host.occupancy_mb, 3),
+                        path=cfg.host_cache_dir)
+            # publish occupancy from tick zero: `obs top` renders a
+            # null gauge as tier-DISABLED, and an enabled-but-cold
+            # tier must read 0.00/0M instead
+            self.metrics.observe_host_cache(
+                self.host.occupancy_mb, len(self.host))
         self._bt = np.zeros((cfg.slots, self._mb), np.int32)
         self._bt_dev = None   # device mirror of (_bt, live); None = stale
         self._pending_reserve: dict[str, int] = {}
@@ -825,6 +859,41 @@ class Engine:
             self.mgr.release(take)
         return blocks
 
+    def _spill_block(self, chain_tokens: tuple[int, ...],
+                     block: int) -> None:
+        """Radix eviction's demotion hook (blocks.py `_drop`): read the
+        dying block's K/V out of the device pool into one stacked host
+        array and hand it to the host tier keyed by its full chain
+        prefix. Eager per-layer D2H reads — none of the engine's
+        tracked jits are involved, so `compile_stats()` stays flat."""
+        payload = np.stack([
+            np.stack([np.asarray(layer["k"][block]),
+                      np.asarray(layer["v"][block])])
+            for layer in self._cache])  # [L, 2, bs, H, D]
+        if self.host.put(chain_tokens, payload):
+            self.metrics.on_host_spill(payload.nbytes)
+            self.metrics.observe_host_cache(
+                self.host.occupancy_mb, len(self.host))
+
+    def _restore_blocks(self, blocks: list[int],
+                        payloads: list[np.ndarray]) -> int:
+        """The promotion half: scatter spilled host payloads into
+        freshly allocated device blocks — one device_put + `.at[].set`
+        block-scatter per layer, eager (never a tracked jit), and the
+        D2H/H2D round trip in the pool's own dtype is bit-exact, so a
+        restored stream matches the never-evicted run. Returns bytes
+        moved."""
+        ids = jnp.asarray(np.asarray(blocks, np.int32))
+        stacked = np.stack(payloads)  # [n, L, 2, bs, H, D]
+        moved = int(stacked.nbytes)
+        dev = jax.device_put(stacked)
+        self._cache = [
+            {"k": layer["k"].at[ids].set(dev[:, li, 0]),
+             "v": layer["v"].at[ids].set(dev[:, li, 1])}
+            for li, layer in enumerate(self._cache)
+        ]
+        return moved
+
     def _free_slot(self, slot: int) -> None:
         seq = self._seqs[slot]
         if seq is not None:
@@ -848,9 +917,26 @@ class Engine:
         shared: list[int] = []
         cow_src: int | None = None
         start = 0
+        host_payloads: list[np.ndarray] = []
+        device_start = 0
         if self.prefix is not None:
             m = self.prefix.lookup(prompt, P - 1)
             shared, start, cow_src = m.blocks, m.tokens, m.cow_src
+            device_start = start
+            if self.host is not None:
+                # device-miss -> host-hit fall-through: probe the host
+                # tier for full-block chain links beyond the device
+                # match. A host extension only wins when it covers MORE
+                # than the device walk (its mid-block COW extension
+                # included) — then the restore supersedes the COW copy.
+                base = len(shared) * bs
+                host_payloads = self.host.match(prompt, base, P - 1)
+                if host_payloads \
+                        and base + len(host_payloads) * bs > start:
+                    start = base + len(host_payloads) * bs
+                    cow_src = None
+                else:
+                    host_payloads = []
         need_now = blocks_for(P, bs) - len(shared)
         # pin the matched chain (and the COW source) BEFORE allocating:
         # allocation may evict radix holds, and a trie-only block we
@@ -887,8 +973,34 @@ class Engine:
                 self._cache, idx, jnp.asarray([fresh[0]], jnp.int32))
             self.mgr.decref([cow_src])  # the pin; the copy is ours now
             self.metrics.on_cow()
+        if host_payloads:
+            # promote the matched chain out of the host tier: the first
+            # len(host_payloads) fresh blocks are exactly the logical
+            # positions after the device-shared span, so the scatter
+            # lands them where the block table will address them. The
+            # post-prefill `prefix.insert` re-registers the whole chain
+            # (restored blocks included) in the radix, so the prefix is
+            # device-cached again for the next sharer.
+            moved = self._restore_blocks(
+                fresh[:len(host_payloads)], host_payloads)
+            host_tokens = len(host_payloads) * bs
+            self.metrics.on_host_restore(len(host_payloads), moved)
+            self.metrics.observe_host_cache(
+                self.host.occupancy_mb, len(self.host))
+            self.tracer.event(
+                "host_restore", request=req.id, tick=self._tick_no,
+                blocks=len(host_payloads), tokens=host_tokens,
+                bytes=moved, **_tr(req))
         if self.prefix is not None:
             self.metrics.on_prefix_lookup(P, start)
+            # tier attribution: under a host hit the device's share is
+            # the full-block walk (the superseded COW extension never
+            # ran), so device + host sum to exactly `start`
+            self.metrics.on_tier_lookup(
+                device_tokens=len(shared) * bs if host_payloads
+                else device_start,
+                host_tokens=len(host_payloads) * bs)
+            self._hot_roots.note(prefix_root_digest(prompt))
         resumed = req.first_token_at is not None
         C = self.cfg.prefill_chunk
         if C > 0 and P - start > C:
@@ -1511,6 +1623,11 @@ class Engine:
             "kv_pool_bytes": int(self.cfg.num_blocks * bb),
             "blocks_in_use_bytes": int(self.mgr.in_use * bb),
             "kv_gather_bytes_per_tick": gather,
+            # the host tier's occupancy rides the same ledger the HBM
+            # numbers do — spilled KV is memory too, just cheaper
+            "host_cache_mb": round(self.host.occupancy_mb, 3)
+            if self.host is not None else 0.0,
+            "host_cache_budget_mb": self.cfg.host_cache_mb,
             "rss_mb": hb_host_rss_mb(),
         }
 
@@ -1853,10 +1970,12 @@ class Engine:
             self.mgr.in_use, self.mgr.num_free, self.n_active,
             self._block_bytes)
         self._slo_tick()
+        roots = self._hot_roots.top()
         self.hb.beat(step=self._tick_no, phase="serve",
                      active=self.n_active, queue=len(self.queue),
                      **({"alerts": self.slo.active_names()}
-                        if self.slo is not None else {}))
+                        if self.slo is not None else {}),
+                     **({"prefix_roots": roots} if roots else {}))
         seg["slo"] = _CLOCK() - t_seg
         self.tickprof.record(self._tick_no, seg,
                              _CLOCK() - p_start)
@@ -1881,7 +2000,8 @@ class Engine:
         self.tracer.event(
             "serve_start", slots=self.cfg.slots, max_len=self.cfg.max_len,
             block_size=self.cfg.block_size, num_blocks=self.cfg.num_blocks,
-            prefix_cache=self.cfg.prefix_cache)
+            prefix_cache=self.cfg.prefix_cache,
+            host_cache_mb=self.cfg.host_cache_mb)
         self.hb.pulse(phase="serve", step=self._tick_no)
         try:
             while True:
@@ -1910,10 +2030,13 @@ class Engine:
                     # same payload shape as the serve beat so a watcher
                     # (obs doctor) reads occupancy whichever phase the
                     # loop froze in
+                    idle_roots = self._hot_roots.top()
                     self.hb.beat(step=self._tick_no, phase="serve_idle",
                                  active=0, queue=len(self.queue),
                                  **({"alerts": self.slo.active_names()}
-                                    if self.slo is not None else {}))
+                                    if self.slo is not None else {}),
+                                 **({"prefix_roots": idle_roots}
+                                    if idle_roots else {}))
                     time.sleep(idle_sleep_s)
                     continue
                 self.step()
@@ -1935,7 +2058,25 @@ class Engine:
                 prefix_hits=summary["prefix_hits"],
                 preempted=summary["preempted"],
                 alerts_raised=summary["alerts_raised"],
+                # the tier split rides the terminal record so smoke/
+                # doctor read host-tier evidence without a snapshot
+                tier_hits_host=summary["tier_hits_host"],
+                tier_hits_device=summary["tier_hits_device"],
+                tier_miss=summary["tier_miss"],
+                host_spilled_blocks=summary["host_spilled_blocks"],
+                host_restored_blocks=summary["host_restored_blocks"],
             )
+            if self.host is not None and self.cfg.host_cache_dir:
+                try:
+                    st = self.host.save(self.cfg.host_cache_dir)
+                    self.tracer.event(
+                        "hostcache_saved", chains=st["chains"],
+                        mb=st["mb"], path=self.cfg.host_cache_dir)
+                except OSError as e:
+                    # persistence is an optimization, never a crash on
+                    # the drain path — say so and finish the drain
+                    print(f"[serve] host-cache save failed: {e}",
+                          file=sys.stderr)
             self.flight_spill("serve_end")
             # the file holds only the LAST beat, so the terminal pulse
             # repeats the occupancy payload — a watcher reading a

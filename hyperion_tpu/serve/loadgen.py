@@ -47,6 +47,11 @@ SERVING_REPORT_KEYS = (
     "accept_rate", "tokens_per_tick", "spec_drafted", "spec_accepted",
     "spec_rejected", "shed", "brownout_clamped", "shed_rate",
     "clamp_rate",
+    # tiered KV cache (PR 20, serve/hostcache.py): where prefix
+    # lookups landed (device radix / host spill tier / miss) and what
+    # the host tier moved — the `rehit` workload's verdict keys
+    "tier_hits_device", "tier_hits_host", "tier_miss",
+    "tier_hit_rate_host", "restore_bytes_per_s", "host_cache_mb",
     *(f"{p}_p99_ms" for p in PHASES),
     "dominant_phase_p99", "ttft_p99_windowed_ms", "tpot_p99_windowed_ms",
     "alerts_raised", "alerts_active", "recompiles",
@@ -96,6 +101,19 @@ class LoadSpec:
     adversary_every: int = 0       # every Nth request is the tenant's
     adversary_secs: float = 0.05   # slowloris per-token stall
     adversary_prompt_len: int = 0  # oversize length (0 = 4x max base)
+    # --- rehit churn (PR 20, serve/hostcache.py) ---
+    # > 0 (with shared_prefix_tokens): the tiered-KV drill shape. The
+    # MIDDLE rehit_churn requests swap the shared prefix for DISTINCT
+    # per-request prompts long enough to evict the shared chain from a
+    # small device pool; the tail of the workload then re-asks for the
+    # original prefix. With --host-cache-mb the re-hit restores from
+    # the host spill tier (tier_hits_host > 0, prefill skipped); with
+    # the tier off it is a full re-prefill — the delta `obs diff`
+    # gates. Churn prompts come from their OWN rng (seed + 0x0C0C),
+    # after the base draws, so enabling churn never shifts the pinned
+    # base schedule (same discipline as the adversary shaping).
+    rehit_churn: int = 0
+    rehit_churn_len: int = 0       # churn prompt len (0 = prefix + max tail)
 
 
 def request_id(seed: int, i: int) -> str:
@@ -156,6 +174,13 @@ def build_workload(spec: LoadSpec):
             for i in adv:
                 prompt_of[i] = arng.integers(1, spec.vocab, plen)
                 cls_of[i] = CLASS_BATCH
+    if spec.rehit_churn > 0 and spec.shared_prefix_tokens:
+        crng = np.random.default_rng(spec.seed + 0x0C0C)
+        plen = spec.rehit_churn_len \
+            or spec.shared_prefix_tokens + max(spec.prompt_lens)
+        a = max(1, (spec.n_requests - spec.rehit_churn) // 2)
+        for i in range(a, min(a + spec.rehit_churn, spec.n_requests)):
+            prompt_of[i] = crng.integers(1, spec.vocab, plen)
 
     reqs = [
         Request(
@@ -306,6 +331,13 @@ def run_load(engine, spec: LoadSpec) -> dict:
            for k in ("prefix_hit_rate", "prefill_tokens_saved",
                      "preempted", "cow_copies", "blocks_in_use",
                      "hbm_per_req_mb")},
+        # tiered KV cache (serve/hostcache.py): lookup tier split and
+        # host-tier motion — the `rehit` workload's verdict keys, gated
+        # by `obs diff` (hit rate higher-is-better, saved tokens delta)
+        **{k: cache.get(k)
+           for k in ("tier_hits_device", "tier_hits_host", "tier_miss",
+                     "tier_hit_rate_host", "restore_bytes_per_s",
+                     "host_cache_mb")},
         # speculative decoding (serve/draft.py): acceptance quality +
         # effective per-slot advance — `obs diff` gates both as
         # higher-is-better on spec-enabled rows (accept_rate is None

@@ -60,7 +60,15 @@ class ServeMetrics:
                      # compile ledger (obs/ledger.py): pinned at zero
                      # by the obs diff gate — any value > 0 is a broken
                      # recompile-free invariant
-                     "serve_recompiles"):
+                     "serve_recompiles",
+                     # tiered KV (serve/hostcache.py): every radix walk
+                     # lands in exactly one tier bucket — host when the
+                     # spill tier restored anything, device when only
+                     # HBM blocks matched, miss otherwise
+                     "serve_tier_hits_device", "serve_tier_hits_host",
+                     "serve_tier_miss", "serve_host_spilled_blocks",
+                     "serve_host_restored_blocks", "serve_spill_bytes",
+                     "serve_restore_bytes"):
             self.reg.counter(name)
         # per-SLO-class lifecycle counters: the isolation contract is
         # judged from these (batch sheds while interactive sheds stay
@@ -73,6 +81,9 @@ class ServeMetrics:
         self._spec_accepted = 0
         self._tick_tokens = 0
         self._ticks = 0
+        self._tier_lookups = 0
+        self._tier_host_hits = 0
+        self._restore_bytes = 0
         # 0/1 flag, pre-set so "never browned out" snapshots as 0
         self.reg.gauge("serve_brownout_active").set(0.0)
         self.reg.gauge("serve_alerts_active").set(0.0)
@@ -154,6 +165,48 @@ class ServeMetrics:
             self.reg.counter("serve_prefill_tokens_saved").inc(cached_tokens)
         self.reg.gauge("serve_prefix_hit_rate").set(
             self._prefix_hits / self._prefix_lookups)
+
+    # ------------------------------------------ tiered KV (hostcache)
+
+    def on_tier_lookup(self, device_tokens: int, host_tokens: int) -> None:
+        """Tier attribution for one radix walk (engine `_admit`): the
+        host bucket means the spill tier restored at least one block
+        this admission — the copy that replaced a re-prefill. The
+        host-hit-rate gauge is host hits over ALL lookups: the fraction
+        of admissions the host tier personally rescued."""
+        self._tier_lookups += 1
+        if host_tokens > 0:
+            self._tier_host_hits += 1
+            self.reg.counter("serve_tier_hits_host").inc()
+        elif device_tokens > 0:
+            self.reg.counter("serve_tier_hits_device").inc()
+        else:
+            self.reg.counter("serve_tier_miss").inc()
+        self.reg.gauge("serve_tier_hit_rate_host").set(
+            self._tier_host_hits / self._tier_lookups)
+
+    def on_host_spill(self, nbytes: int) -> None:
+        """One block demoted device -> host (radix eviction's spill)."""
+        self.reg.counter("serve_host_spilled_blocks").inc()
+        self.reg.counter("serve_spill_bytes").inc(nbytes)
+
+    def on_host_restore(self, blocks: int, nbytes: int) -> None:
+        """One admission promoted `blocks` spilled blocks host ->
+        device. The bytes/s gauge is the windowed restore bandwidth —
+        the H2D cost the tier pays instead of re-prefill compute."""
+        self.reg.counter("serve_host_restored_blocks").inc(blocks)
+        self.reg.counter("serve_restore_bytes").inc(nbytes)
+        self._restore_bytes += nbytes
+        elapsed = self._clock() - self._t0
+        if elapsed > 0:
+            self.reg.gauge("serve_restore_bytes_per_s").set(
+                self._restore_bytes / elapsed)
+
+    def observe_host_cache(self, occupancy_mb: float, chains: int) -> None:
+        """Host-tier occupancy after a spill or restore — the memory
+        ledger's host-side sibling of blocks_in_use."""
+        self.reg.gauge("serve_host_cache_mb").set(occupancy_mb)
+        self.reg.gauge("serve_host_cache_chains").set(chains)
 
     def on_preempt(self) -> None:
         self.reg.counter("serve_preempted").inc()
@@ -310,6 +363,20 @@ class ServeMetrics:
             "preempted": int(c.get("serve_preempted", 0)),
             "cow_copies": int(c.get("serve_cow_copies", 0)),
             "blocks_evicted": int(c.get("serve_blocks_evicted", 0)),
+            # tiered KV (serve/hostcache.py): the device/host/miss
+            # split plus the spill tier's own traffic
+            "tier_hits_device": int(c.get("serve_tier_hits_device", 0)),
+            "tier_hits_host": int(c.get("serve_tier_hits_host", 0)),
+            "tier_miss": int(c.get("serve_tier_miss", 0)),
+            "tier_hit_rate_host": g.get("serve_tier_hit_rate_host", 0.0),
+            "host_spilled_blocks": int(
+                c.get("serve_host_spilled_blocks", 0)),
+            "host_restored_blocks": int(
+                c.get("serve_host_restored_blocks", 0)),
+            "restore_bytes": int(c.get("serve_restore_bytes", 0)),
+            "restore_bytes_per_s": g.get("serve_restore_bytes_per_s",
+                                         0.0),
+            "host_cache_mb": g.get("serve_host_cache_mb", 0.0),
             "blocks_in_use": g.get("serve_blocks_in_use"),
             "hbm_per_req_mb": g.get("serve_hbm_per_req_mb"),
             # crash safety + overload (journal/drain/brownout)
@@ -386,7 +453,12 @@ class RouterMetrics:
                      # new router life, replicas adopted (taken over
                      # live, no respawn) from a previous life
                      "route_resumes", "route_orphans_recovered",
-                     "route_adopted"):
+                     "route_adopted",
+                     # cache-aware routing (serve/hostcache.py): the
+                     # dispatch went to a replica ADVERTISING the
+                     # request's prefix root on its heartbeat — prefix
+                     # locality without a session id
+                     "route_cache_steered"):
             self.reg.counter(name)
         self.reg.gauge("fleet_ready").set(0.0)
         self.reg.gauge("fleet_inflight").set(0.0)
@@ -395,10 +467,12 @@ class RouterMetrics:
         self.reg.gauge("fleet_steered").set(0.0)
 
     def on_dispatch(self, replica: int, affinity_hit: bool,
-                    had_key: bool) -> None:
+                    had_key: bool, cache_hit: bool = False) -> None:
         with self._lock:
             self.reg.counter("route_dispatched").inc()
             self.reg.counter(f"route_dispatched_replica_{replica}").inc()
+            if cache_hit:
+                self.reg.counter("route_cache_steered").inc()
             if had_key:
                 lookups = self.reg.counter("route_affinity_lookups")
                 hits = self.reg.counter("route_affinity_hits")
@@ -515,6 +589,7 @@ class RouterMetrics:
             "affinity_lookups": int(c.get("route_affinity_lookups", 0)),
             "affinity_hits": int(c.get("route_affinity_hits", 0)),
             "affinity_hit_rate": g.get("route_affinity_hit_rate"),
+            "cache_steered": int(c.get("route_cache_steered", 0)),
             "ejections": int(c.get("replica_ejections", 0)),
             "readmits": int(c.get("replica_readmits", 0)),
             "per_replica_dispatched": share,

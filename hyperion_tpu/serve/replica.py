@@ -65,6 +65,10 @@ class ReplicaHandle:
     # the engine's `alerts` heartbeat field) — the router's monitor
     # tallies these fleet-wide and `obs top` shows them per row
     hb_alerts: tuple = ()
+    # hot prefix roots the replica advertised (serve/hostcache.py
+    # digests via the engine's `prefix_roots` heartbeat field) — the
+    # dispatch policy's cache-aware term steers matching requests here
+    hb_prefix_roots: tuple = ()
 
     # --- router-side accounting ---
     # dispatches newer than the last beat: the beat's active/queue
@@ -148,6 +152,10 @@ class ReplicaHandle:
         alerts = hb.get("alerts")
         self.hb_alerts = (tuple(str(a) for a in alerts)
                           if isinstance(alerts, (list, tuple)) else ())
+        roots = hb.get("prefix_roots")
+        self.hb_prefix_roots = (tuple(str(r) for r in roots)
+                                if isinstance(roots, (list, tuple))
+                                else ())
         self.dispatched_since_beat = 0
         if self.state in (STARTING, EJECTED) \
                 and self.hb_phase in SERVE_PHASES \

@@ -97,6 +97,7 @@ from hyperion_tpu.obs import slo as slo_mod
 from hyperion_tpu.obs.export import DEFAULT_WINDOW_S
 from hyperion_tpu.obs.heartbeat import host_rss_mb
 from hyperion_tpu.serve.client import TERMINAL_EVENTS, ServeClient
+from hyperion_tpu.serve.hostcache import prefix_root_digest
 from hyperion_tpu.serve.metrics import RouterMetrics
 from hyperion_tpu.serve.queue import (
     CLASS_BATCH,
@@ -173,7 +174,7 @@ class RouterPolicy:
     def __init__(self, replicas: list[ReplicaHandle], *,
                  affinity_slack: int = 4, affinity_cap: int = 512,
                  prefix_tokens: int = 32, prefix_chars: int = 128,
-                 clock=None):
+                 cache_aware: bool = True, clock=None):
         self.replicas = list(replicas)
         # wall-time source for eject/readmit decisions (heartbeats
         # stamp t_wall); injectable so the fleet simulator can run the
@@ -183,6 +184,7 @@ class RouterPolicy:
         self.affinity_cap = affinity_cap
         self.prefix_tokens = prefix_tokens
         self.prefix_chars = prefix_chars
+        self.cache_aware = cache_aware
         self._affinity: OrderedDict[str, int] = OrderedDict()
         self._ever_ready: set[int] = set()
         self._lock = threading.Lock()
@@ -223,11 +225,22 @@ class RouterPolicy:
         it, and with NO alternative interactive flows too (degraded
         service beats no service). Affinity yields the same way: a
         sticky key whose target is steered re-maps to a clean replica
-        for the latency tier."""
+        for the latency tier.
+
+        Cache-aware term: when no affinity mapping fires, a replica
+        that ADVERTISED this request's prefix-root digest on its last
+        heartbeat (`prefix_roots`, from the engine's tiered KV cache)
+        wins the dispatch if it sits within `affinity_slack` of the
+        least-loaded score — its radix/host tiers already hold the
+        prefix, so landing there skips the prefill the least-loaded
+        replica would recompute. Past the slack (or with no advertiser)
+        the policy degrades to plain least-loaded, and a successful
+        steer seeds the affinity map so the rest of the burst sticks
+        without re-consulting stale advertisements."""
         with self._lock:
             key = self.affinity_key(doc)
             meta = {"had_key": key is not None, "affinity_hit": False,
-                    "steered_away": False}
+                    "steered_away": False, "cache_hit": False}
             ready = [r for r in self.replicas
                      if r.state == READY and r.index not in exclude]
             if not ready:
@@ -246,6 +259,20 @@ class RouterPolicy:
                         <= best.load_score() + self.affinity_slack:
                     target = cand
                     meta["affinity_hit"] = True
+            if not meta["affinity_hit"] and self.cache_aware:
+                ids = doc.get("prompt_ids")
+                digest = (prefix_root_digest(ids)
+                          if isinstance(ids, list) else None)
+                if digest is not None:
+                    hot = min((r for r in ready
+                               if digest in r.hb_prefix_roots),
+                              key=lambda r: (r.load_score(), r.index),
+                              default=None)
+                    if hot is not None and hot.load_score() \
+                            <= best.load_score() + self.affinity_slack:
+                        target = hot
+                        meta["cache_hit"] = True
+            if key is not None:
                 self._affinity[key] = target.index
                 self._affinity.move_to_end(key)
                 while len(self._affinity) > self.affinity_cap:
@@ -355,6 +382,12 @@ def replica_argv(args, rep: ReplicaHandle) -> list[str]:
             "--drain-timeout", str(args.drain_timeout)]
     argv.append("--prefix-cache" if args.prefix_cache
                 else "--no-prefix-cache")
+    # tiered KV host spill (serve/hostcache.py) rides to every replica;
+    # the hot prefix roots their heartbeats advertise back feed the
+    # dispatch policy's cache-aware steering
+    hc = int(getattr(args, "host_cache_mb", 0) or 0)
+    if hc:
+        argv += ["--host-cache-mb", str(hc)]
     # engine-level SLO targets ride to every replica (the TTFT
     # histograms live in the engines; the router only tallies the
     # alerts their heartbeats report back)
@@ -1132,7 +1165,9 @@ class Router:
                 backoff = min(backoff * 2.0, 0.5)
                 continue
             self.metrics.on_dispatch(rep.index, meta["affinity_hit"],
-                                     meta["had_key"])
+                                     meta["had_key"],
+                                     cache_hit=meta.get("cache_hit",
+                                                        False))
             # the hop context: trace id = the minted request id; `hop`
             # counts placements across the request's WHOLE journey
             # (resume relays continue past the legs a previous relay
@@ -1143,8 +1178,9 @@ class Router:
                      "router_life": self.router_life}
             self.tracer.event(
                 "route_dispatch", request=rid, replica=rep.index,
-                affinity=meta["affinity_hit"], redispatch=redispatches,
-                trace=trace)
+                affinity=meta["affinity_hit"],
+                cache_steer=meta.get("cache_hit", False),
+                redispatch=redispatches, trace=trace)
             # WAL before wire: the placement is durable before the
             # replica can possibly have seen the request. The stored
             # line stays the request exactly as the client sent it —
@@ -1667,6 +1703,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-blocks", type=int, default=0)
     p.add_argument("--prefix-cache", action=argparse.BooleanOptionalAction,
                    default=True)
+    p.add_argument("--host-cache-mb", type=int, default=0,
+                   help="per-replica host-RAM KV spill tier "
+                        "(serve/hostcache.py), forwarded to every "
+                        "engine; replicas advertise hot prefix roots "
+                        "on heartbeats and the dispatch policy steers "
+                        "matching no-session requests to an "
+                        "advertising replica within the affinity "
+                        "slack (0 = off)")
     p.add_argument("--queue-capacity", type=int, default=64)
     p.add_argument("--prefill-budget", type=int, default=512)
     p.add_argument("--prefill-chunk", type=int, default=0)

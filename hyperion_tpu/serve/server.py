@@ -483,6 +483,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "with an earlier request skip its prefill and "
                         "share the cached blocks (--no-prefix-cache to "
                         "disable)")
+    p.add_argument("--host-cache-mb", type=int, default=0,
+                   help="tiered KV (serve/hostcache.py): host-RAM spill "
+                        "tier for the radix cache in MB (0 = off). "
+                        "Evicted prefix chains demote to host buffers "
+                        "under this LRU budget and restore with one H2D "
+                        "copy per block on a rehit instead of a "
+                        "re-prefill; the store serializes next to the "
+                        "journal on drain, so spilled chains survive a "
+                        "restart. Needs --prefix-cache")
     p.add_argument("--queue-capacity", type=int, default=64,
                    help="admission queue bound; beyond it requests are "
                         "rejected with reason queue_full")
@@ -781,6 +790,18 @@ def main(argv=None) -> int:
     eos_id = args.eos_id
     if eos_id is None and tok is not None:
         eos_id = tok.eos_id
+    # the host tier's persistence dir rides the journal's recovery
+    # path: next to the WAL when one exists, next to the telemetry
+    # stream otherwise, nowhere (in-memory tier only) when neither
+    host_cache_dir = ""
+    if args.host_cache_mb > 0:
+        from pathlib import Path as _Path
+
+        if args.journal:
+            host_cache_dir = str(_Path(args.journal).parent / "hostcache")
+        elif _env_telemetry_path():
+            host_cache_dir = str(
+                _Path(_env_telemetry_path()).parent / "hostcache")
     engine = Engine(
         model, {"params": params},
         EngineConfig(
@@ -794,6 +815,8 @@ def main(argv=None) -> int:
             batch_deadline_s=args.batch_deadline_s,
             block_size=args.block_size, num_blocks=args.num_blocks,
             prefix_cache=args.prefix_cache,
+            host_cache_mb=args.host_cache_mb,
+            host_cache_dir=host_cache_dir,
             spec_k=args.spec_k, draft=args.draft,
             brownout=args.brownout,
             brownout_depth=args.brownout_depth,

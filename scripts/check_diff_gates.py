@@ -33,6 +33,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from hyperion_tpu.obs.diff import METRICS, ZERO_PINNED, normalize
+from hyperion_tpu.serve.hostcache import ungated_tier_keys
 from hyperion_tpu.serve.loadgen import SERVING_REPORT_KEYS
 from hyperion_tpu.serve.simulate import DIFF_GATED, diff_key
 
@@ -121,10 +122,18 @@ def main(argv: list[str] | None = None) -> int:
     unpinned = sorted(set(ZERO_PINNED) - set(METRICS))
     ungated = ungated_sim_keys()
     ungated_da = ungated_decode_attn_keys()
+    # hostcache.TIER_GATED: the tier keys the spill tier PROMISES obs
+    # diff gates — promised-but-ungated fails tier-1 here, same drift
+    # rule as the simulator's scenario keys
+    ungated_tier = ungated_tier_keys(METRICS)
     if ungated:
         print("check_diff_gates: FAIL — simulate.DIFF_GATED name(s) "
               f"not gated in obs/diff.py METRICS: {', '.join(ungated)}",
               file=sys.stderr)
+    if ungated_tier:
+        print("check_diff_gates: FAIL — hostcache.TIER_GATED name(s) "
+              "not gated in obs/diff.py METRICS: "
+              f"{', '.join(ungated_tier)}", file=sys.stderr)
     if ungated_da:
         print("check_diff_gates: FAIL — bench.py "
               "DECODE_ATTN_REPORT_KEYS name(s) not gated in obs/diff.py "
@@ -137,7 +146,7 @@ def main(argv: list[str] | None = None) -> int:
     if unpinned:
         print("check_diff_gates: FAIL — ZERO_PINNED name(s) not in "
               f"METRICS: {', '.join(unpinned)}", file=sys.stderr)
-    if orphans or unpinned or ungated or ungated_da:
+    if orphans or unpinned or ungated or ungated_da or ungated_tier:
         return 1
     print(f"check_diff_gates: OK — {len(METRICS)} gated metric(s), "
           "all producible from emitter vocabularies")

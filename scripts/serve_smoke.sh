@@ -896,4 +896,97 @@ print(f"[serve_smoke] OK: paged-attn kernel round trip — {len(got)} "
       "kv_gather_bytes_per_tick=0 on the flight record")
 PY
 
+# 13. tiered KV round trip: run A serves a shared-prefix request, then
+#     three churn requests overflow the 10-block pool so the radix cache
+#     EVICTS the shared chain — with --host-cache-mb on, eviction
+#     demotes it to host RAM and the drain saves the store next to the
+#     telemetry stream. Run B is a FRESH process: it loads the store,
+#     and a same-prefix rehit restores the chain from host RAM (tier
+#     hit on the serve_end terminal record). Run C decodes the same
+#     rehit with the tier off — B's stream must be bit-identical.
+printf '%s\n' \
+  '{"id":"s1","prompt_ids":[3,4,5,6,7,8,9,10,11,12,13,14,20,21],"max_new_tokens":4}' \
+  '{"id":"c1","prompt_ids":[40,41,42,43,44,45,46,47,48,49],"max_new_tokens":8}' \
+  '{"id":"c2","prompt_ids":[50,51,52,53,54,55,56,57,58,59],"max_new_tokens":8}' \
+  '{"id":"c3","prompt_ids":[60,61,62,63,64,65,66,67,68,69],"max_new_tokens":8}' \
+  | env HYPERION_TELEMETRY="$WORK/tier_a.jsonl" \
+    python -m hyperion_tpu.cli.main serve \
+      --ckpt "$WORK/llama.npz" --no-tokenizer \
+      --max-len 32 --slots 2 --warmup-lens 8 --block-size 4 \
+      --num-blocks 10 --host-cache-mb 16 \
+      > "$WORK/tier_a_responses.jsonl"
+
+REHIT='{"id":"r1","prompt_ids":[3,4,5,6,7,8,9,10,11,12,13,14,30,31],"max_new_tokens":4}'
+printf '%s\n' "$REHIT" \
+  | env HYPERION_TELEMETRY="$WORK/tier_b.jsonl" \
+    python -m hyperion_tpu.cli.main serve \
+      --ckpt "$WORK/llama.npz" --no-tokenizer \
+      --max-len 32 --slots 2 --warmup-lens 8 --block-size 4 \
+      --num-blocks 10 --host-cache-mb 16 \
+      > "$WORK/tier_b_responses.jsonl"
+
+printf '%s\n' "$REHIT" \
+  | python -m hyperion_tpu.cli.main serve \
+      --ckpt "$WORK/llama.npz" --no-tokenizer \
+      --max-len 32 --slots 2 --warmup-lens 8 --block-size 4 \
+      --num-blocks 10 \
+      > "$WORK/tier_ref_responses.jsonl"
+
+python - "$WORK" <<'PY'
+import json
+import sys
+from pathlib import Path
+
+work = Path(sys.argv[1])
+
+
+def records(name):
+    out = []
+    for line in (work / name).read_text().splitlines():
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            pass
+    return out
+
+
+def ev(recs, name):
+    return [r for r in recs if r.get("name") == name]
+
+
+# run A: the churn evicted the shared chain INTO the tier, and the
+# drain serialized the store
+a = records("tier_a.jsonl")
+(end_a,) = ev(a, "serve_end")
+assert end_a["host_spilled_blocks"] >= 3, (
+    f"run A did not spill s1's whole chain to the host tier: {end_a}")
+(saved,) = ev(a, "hostcache_saved")
+assert saved["chains"] >= 1
+assert (work / "hostcache" / "index.json").exists(), (
+    "drain did not persist the host store next to the telemetry stream")
+
+# run B: a fresh process loaded the store and fed the rehit from it
+b = records("tier_b.jsonl")
+assert ev(b, "hostcache_loaded"), "run B never loaded the saved store"
+assert ev(b, "host_restore"), "run B never restored from the host tier"
+(end_b,) = ev(b, "serve_end")
+assert end_b["tier_hits_host"] >= 1, f"no host-tier hit on rehit: {end_b}"
+assert end_b["host_restored_blocks"] >= 1
+
+
+def stream(name):
+    return [r["token"] for r in records(name)
+            if r.get("id") == "r1" and r.get("event") == "token"
+            and r.get("token") is not None]
+
+
+got, ref = stream("tier_b_responses.jsonl"), stream("tier_ref_responses.jsonl")
+assert len(ref) == 4 and got == ref, (
+    f"host-tier restore diverged from the tier-off run: {got} != {ref}")
+print(f"[serve_smoke] OK: tiered KV round trip — "
+      f"{end_a['host_spilled_blocks']} block(s) spilled, store survived "
+      f"the restart, rehit restored {end_b['host_restored_blocks']} "
+      "block(s) bit-identically")
+PY
+
 echo "[serve_smoke] all legs passed"

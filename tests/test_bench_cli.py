@@ -68,7 +68,17 @@ GOOD_PIPELINE = {"sync_batches_per_s": 300.0,
                  "prefetch_batches_per_s": 360.0, "speedup": 1.2}
 GOOD_SERVING = {"tokens_per_s": 650.0, "ttft_p50_ms": 12.0,
                 "ttft_p99_ms": 40.0, "reject_rate": 0.0,
-                "completed": 32, "rejected": 0}
+                "completed": 32, "rejected": 0,
+                # tiered KV cache (PR 20): the @rehit dimension's tier
+                # keys ride the serving row top-level (the ON point's
+                # values) plus the off/host sub-rows
+                "tier_hits_device": 20, "tier_hits_host": 6,
+                "tier_miss": 6, "tier_hit_rate_host": 0.1875,
+                "restore_bytes_per_s": 5.0e6, "host_cache_mb": 8,
+                "rehit": {"off": {"tier_hits_host": 0,
+                                  "prefill_tokens_saved": 448},
+                          "host": {"tier_hits_host": 6,
+                                   "prefill_tokens_saved": 832}}}
 GOOD_SCALE = {"replicas": 2, "tokens_per_s_1r": 400.0,
               "tokens_per_s": 700.0, "scaleup": 1.75,
               "request_share": {"0": 0.5, "1": 0.5}, "fairness": 1.0,
@@ -148,6 +158,10 @@ class TestBenchMain:
         # the paged-attention probe row too, canonical names included
         assert out["decode_attention"]["decode_attn_tokens_per_s"] == 1500.0
         assert out["decode_attention"]["decode_attn_recompiles"] == 0
+        # tiered-KV tier keys (the @rehit dimension) ride the serving
+        # row where obs diff's normalize() reads them
+        assert out["serving"]["tier_hit_rate_host"] == 0.1875
+        assert out["serving"]["rehit"]["host"]["tier_hits_host"] == 6
 
     def test_dead_tunnel_emits_failure_with_sanity(self, bench, clock,
                                                    capsys, monkeypatch):
@@ -177,6 +191,9 @@ class TestBenchMain:
         assert "serving" in out
         assert "serving_scale" in out
         assert "decode_attention" in out
+        # the tier keys ride the FAILURE line too — the tiered-KV
+        # trajectory stays continuous across dead rounds
+        assert out["serving"]["tier_hit_rate_host"] == 0.1875
         # total simulated wall time stayed inside the deadline
         assert clock.t - 1000.0 <= bench.DEADLINE_S
 

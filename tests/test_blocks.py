@@ -253,3 +253,231 @@ class TestRandomOpSoak:
         mgr.check()
         assert mgr.num_free == mgr.capacity, "pool leaked blocks"
         assert mgr.reserved == 0
+
+
+# --------------------------------------------------- host spill tier
+
+
+def _chain_payload(chain, bs):
+    """Deterministic per-chain K/V stand-in: full blocks are immutable
+    by the radix invariant, so the chain key fully determines the
+    bytes — which makes every restore checkable for bit-identity."""
+    import zlib
+
+    seed = zlib.crc32(np.asarray(chain, np.int64).tobytes())
+    return np.random.default_rng(seed).standard_normal(
+        (2, 2, bs, 4), dtype=np.float32)
+
+
+class TestHostBlockStore:
+    def _store(self, bs=4):
+        from hyperion_tpu.serve.hostcache import HostBlockStore
+
+        return HostBlockStore(budget_mb=1, block_size=bs)
+
+    def test_match_walks_consecutive_chain_keys(self):
+        bs, store = 4, self._store()
+        toks = list(np.random.default_rng(0).integers(1, 200, 12))
+        for nblk in (1, 2, 3):
+            store.put(toks[:nblk * bs],
+                      _chain_payload(toks[:nblk * bs], bs))
+        # limit=len-1 (the radix rule): the third block needs position
+        # 12 <= 11 and stays un-matched even though the store holds it
+        hits = store.match(toks, 0, len(toks) - 1)
+        assert len(hits) == 2
+        for i, h in enumerate(hits):
+            ref = _chain_payload(toks[:(i + 1) * bs], bs)
+            assert h.dtype == ref.dtype and np.array_equal(h, ref)
+        # a device base of one full block: the walk starts past it
+        assert len(store.match(toks, bs, len(toks) - 1)) == 1
+        # a missing middle link stops the walk cold
+        store.clear()
+        store.put(toks[:bs], _chain_payload(toks[:bs], bs))
+        store.put(toks[:3 * bs], _chain_payload(toks[:3 * bs], bs))
+        assert len(store.match(toks, 0, len(toks))) == 1
+
+    def test_lru_budget_evicts_oldest_and_match_refreshes(self):
+        from hyperion_tpu.serve.hostcache import HostBlockStore
+
+        bs = 4
+        store = HostBlockStore(budget_mb=1, block_size=bs)
+        # ~341 KB each: the fourth put must evict the LRU chain
+        big = np.zeros((341, 256), np.float32)
+        keys = [list(range(i * 100, i * 100 + bs)) for i in range(4)]
+        for k in keys[:3]:
+            assert store.put(k, big + sum(k))
+        assert store.evictions == 0
+        store.match(keys[0], 0, bs)        # touch 0 — key 1 becomes LRU
+        assert store.put(keys[3], big)
+        assert store.evictions == 1
+        assert store.bytes_used <= store.budget_bytes
+        assert store.match(keys[1], 0, bs) == []      # the LRU died
+        assert len(store.match(keys[0], 0, bs)) == 1  # the touched lived
+        # an oversize payload is refused (counted), never raised
+        assert not store.put([900, 901, 902, 903],
+                             np.zeros(2 ** 19, np.float64))
+        assert store.rejected == 1
+
+    def test_duplicate_put_refreshes_not_overwrites(self):
+        bs, store = 4, self._store()
+        key = [1, 2, 3, 4]
+        first = _chain_payload(key, bs)
+        assert store.put(key, first)
+        assert store.put(key, np.zeros_like(first))  # immutable content
+        assert store.bytes_used == first.nbytes      # no double count
+        (got,) = store.match(key, 0, bs)
+        assert np.array_equal(got, first)
+
+    def test_save_load_roundtrip_bit_identical(self, tmp_path):
+        from hyperion_tpu.serve.hostcache import HostBlockStore
+
+        bs, store = 4, self._store()
+        toks = list(np.random.default_rng(1).integers(1, 200, 8))
+        for nblk in (1, 2):
+            store.put(toks[:nblk * bs],
+                      _chain_payload(toks[:nblk * bs], bs))
+        store.save(str(tmp_path / "hostcache"))
+        fresh = HostBlockStore(budget_mb=1, block_size=bs)
+        assert fresh.load(str(tmp_path / "hostcache")) == 2
+        hits = fresh.match(toks, 0, len(toks))
+        assert len(hits) == 2
+        for i, h in enumerate(hits):
+            assert np.array_equal(
+                h, _chain_payload(toks[:(i + 1) * bs], bs))
+        # alien geometry loads nothing; a missing dir loads nothing
+        alien = HostBlockStore(budget_mb=1, block_size=8)
+        assert alien.load(str(tmp_path / "hostcache")) == 0
+        assert fresh.load(str(tmp_path / "absent")) == 2 - 2 + 0
+
+
+class TestRadixSpillSeam:
+    def test_evict_demotes_chains_to_host(self):
+        """Demote, not delete: every chain `evict` kills at refcount 1
+        reaches the spill callback with its FULL token prefix, and the
+        host store can then extend a cold device base over the whole
+        evicted prefix."""
+        from hyperion_tpu.serve.hostcache import HostBlockStore
+
+        bs = 4
+        mgr = BlockManager(32, bs)
+        store = HostBlockStore(budget_mb=1, block_size=bs)
+        spilled = []
+
+        def spill(chain, blk):
+            spilled.append((chain, blk))
+            store.put(chain, _chain_payload(chain, bs))
+
+        trie = RadixPrefixCache(mgr, spill=spill)
+        toks = np.random.default_rng(5).integers(1, 200, 12)
+        seq = mgr.alloc(3)
+        trie.insert(toks, seq)
+        mgr.decref(seq)
+        assert trie.evict(3) == 3
+        # leaves-first eviction: deepest chain dies first, and each key
+        # is the root..block prefix with the block's own tokens last
+        assert [len(c) for c, _ in spilled] == [12, 8, 4]
+        assert [b for _, b in spilled] == [seq[2], seq[1], seq[0]]
+        for chain, _ in spilled:
+            assert chain == tuple(int(t) for t in toks[:len(chain)])
+        hits = store.match(toks, 0, len(toks) - 1)
+        assert len(hits) == 2      # 11-position cap: two full blocks
+        assert np.array_equal(
+            hits[0], _chain_payload(tuple(toks[:bs]), bs))
+
+    def test_shared_chain_and_clear_never_spill(self):
+        mgr = BlockManager(32, 4)
+        spilled = []
+        trie = RadixPrefixCache(mgr, spill=lambda c, b: spilled.append(c))
+        toks = np.random.default_rng(6).integers(1, 200, 8)
+        seq = mgr.alloc(2)
+        trie.insert(toks, seq)
+        assert trie.evict(2) == 0 and spilled == []  # seq still holds
+        mgr.decref(seq)
+        trie.clear()                # shutdown drops holds, no demotion
+        assert spilled == []
+        assert mgr.num_free == mgr.capacity
+
+
+class TestHostSpillSoak:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_spill_restore_soak_never_leaks(self, seed, tmp_path):
+        """The tier acceptance property: a random interleaving of admit
+        (device + host lookup), free, and pressure-evict (demoting into
+        the host store) keeps the device pool leak-free, keeps the host
+        byte accounting exact and under budget, and hands back only
+        bit-identical payloads — then a save/load survives with every
+        chain intact."""
+        from hyperion_tpu.serve.hostcache import HostBlockStore
+
+        rng = np.random.default_rng(seed)
+        bs = 4
+        mgr = BlockManager(24, bs)      # small pool: real evict pressure
+        store = HostBlockStore(budget_mb=1, block_size=bs)
+        trie = RadixPrefixCache(
+            mgr, spill=lambda chain, blk: store.put(
+                chain, _chain_payload(chain, bs)))
+        live: list[dict] = []
+        corpus = [rng.integers(1, 50, int(rng.integers(4, 20)))
+                  for _ in range(6)]
+
+        def admit():
+            base = corpus[rng.integers(0, len(corpus))]
+            toks = np.concatenate(
+                [base, rng.integers(1, 50, int(rng.integers(0, 6)))])
+            P = len(toks)
+            m = trie.lookup(toks, P - 1)
+            pin = list(m.blocks) + (
+                [m.cow_src] if m.cow_src is not None else [])
+            mgr.incref(pin)
+            # the host walk starts where device coverage ends — every
+            # payload it returns must be byte-for-byte what was spilled
+            for i, h in enumerate(store.match(
+                    toks, len(m.blocks) * bs, P - 1)):
+                chain = tuple(int(t)
+                              for t in toks[:(len(m.blocks) + i + 1) * bs])
+                ref = _chain_payload(chain, bs)
+                assert h.dtype == ref.dtype and np.array_equal(h, ref)
+            need = blocks_for(P, bs) - len(m.blocks)
+            fresh = mgr.alloc(need)
+            if fresh is None and trie.evict(need - mgr.num_free):
+                fresh = mgr.alloc(need)
+            if fresh is None:
+                mgr.decref(pin)
+                return
+            if m.cow_src is not None:
+                mgr.decref([m.cow_src])
+            seq = SeqAlloc(blocks=list(m.blocks) + fresh,
+                           n_shared=len(m.blocks), n_filled=P)
+            trie.insert(toks, seq.blocks)
+            live.append({"seq": seq, "toks": toks})
+
+        def free():
+            if not live:
+                return
+            entry = live.pop(rng.integers(0, len(live)))
+            mgr.decref(entry["seq"].blocks)
+
+        def pressure():
+            trie.evict(2)
+
+        ops = [admit, admit, free, pressure]
+        for _ in range(300):
+            ops[rng.integers(0, len(ops))]()
+            mgr.check()
+            assert store.bytes_used == sum(
+                p.nbytes for p in store._chains.values())
+            assert store.bytes_used <= store.budget_bytes
+
+        while live:
+            free()
+        trie.clear()
+        mgr.check()
+        assert mgr.num_free == mgr.capacity, "pool leaked blocks"
+        # the soak really demoted something, and persistence keeps it
+        assert store.spills > 0
+        snap = {k: v.copy() for k, v in store._chains.items()}
+        store.save(str(tmp_path / "hc"))
+        fresh = HostBlockStore(budget_mb=1, block_size=bs)
+        assert fresh.load(str(tmp_path / "hc")) == len(snap)
+        for k, v in snap.items():
+            assert np.array_equal(fresh._chains[k], v)

@@ -295,6 +295,89 @@ class TestSpeculationIncident:
         assert "serve speculation" not in doctor.render_markdown(d)
 
 
+def write_tiered_serve_run(path, run: str, *, host_cache_mb,
+                           evicted=0, spilled=0, host_hits=0,
+                           tier_miss=0, restores=0, saved_chains=None):
+    """A finished serve-shaped run with the tiered-KV evidence trail:
+    `serve_start` declares the tier budget, the snapshot carries the
+    tier counters (serve/metrics.py), and `host_restore` /
+    `hostcache_saved` events say the tier actually moved bytes."""
+    clk, wall = VirtualClock(100.0), VirtualClock(1_000.0)
+    t = Tracer(path, run=run, proc=0, clock=clk, wall=wall)
+    t.event("serve_start", host_cache_mb=host_cache_mb)
+    for i in range(restores):
+        t.event("host_restore", request=f"q{i}", tick=i, blocks=2,
+                tokens=16, bytes=4096)
+    reg = MetricsRegistry()
+    reg.counter("serve_ticks").inc(50)
+    reg.counter("serve_completed").inc(4)
+    reg.counter("serve_blocks_evicted").inc(evicted)
+    reg.counter("serve_host_spilled_blocks").inc(spilled)
+    reg.counter("serve_host_restored_blocks").inc(2 * restores)
+    reg.counter("serve_tier_hits_host").inc(host_hits)
+    reg.counter("serve_tier_hits_device").inc(1)
+    reg.counter("serve_tier_miss").inc(tier_miss)
+    reg.gauge("queue_depth").set(0.0)
+    t.snapshot(reg, step=50)
+    if saved_chains is not None:
+        t.event("hostcache_saved", chains=saved_chains, mb=0.5,
+                path=str(path.parent / "hostcache"))
+    t.event("serve_end")
+    t.close()
+
+
+class TestTieredKVIncidents:
+    """`obs doctor` on the host-spill tier: evictions with the tier OFF
+    and spills the workload never came back for are DIFFERENT named
+    incidents with different knobs — and a tier that fed re-hits is
+    evidence, not a complaint."""
+
+    def test_evictions_with_tier_disabled_are_named(self, tmp_path):
+        write_tiered_serve_run(tmp_path / "telemetry.jsonl", "r1",
+                               host_cache_mb=0, evicted=7, tier_miss=3)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["verdict"] == "healthy"
+        assert d["tier_incidents"], "disabled tier produced no incident"
+        assert "host tier DISABLED" in d["reason"]
+        assert "--host-cache-mb" in d["reason"]
+        md = doctor.render_markdown(d)
+        assert "serve cache tiers" in md
+        assert "**tier incident**" in md
+
+    def test_spills_without_rehits_is_undersized(self, tmp_path):
+        write_tiered_serve_run(tmp_path / "telemetry.jsonl", "r1",
+                               host_cache_mb=4, evicted=7, spilled=7,
+                               host_hits=0, tier_miss=5)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["tier_incidents"]
+        assert "--host-cache-mb likely undersized" in d["reason"]
+        assert d["host_tier"]["budget_mb"] == 4
+
+    def test_tier_feeding_rehits_stays_quiet(self, tmp_path):
+        write_tiered_serve_run(tmp_path / "telemetry.jsonl", "r1",
+                               host_cache_mb=64, evicted=7, spilled=7,
+                               host_hits=3, tier_miss=5, restores=3,
+                               saved_chains=5)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["tier_incidents"] == []
+        assert "cache tier" not in d["reason"]
+        # the evidence row still renders, unflagged, with the
+        # drain-time save cited
+        assert d["host_tier"]["restore_events"] == 3
+        assert d["host_tier"]["saved"] == {"chains": 5, "mb": 0.5}
+        md = doctor.render_markdown(d)
+        assert "serve cache tiers" in md
+        assert "**tier incident**" not in md
+
+    def test_tierless_run_has_no_row(self, tmp_path):
+        write_spec_serve_run(tmp_path / "telemetry.jsonl", "r1",
+                             drafted=0, accepted=0)
+        d = doctor.diagnose(tmp_path, now=1_100.0)
+        assert d["tier_incidents"] == []
+        assert d["host_tier"] is None
+        assert "serve cache tiers" not in doctor.render_markdown(d)
+
+
 class TestTenantAttributionAndRouterActions:
     """PR 14: when adversarial tenants drive the pressure, the doctor
     NAMES the offending tenant from the admit/shed event trail; and

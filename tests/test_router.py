@@ -208,6 +208,147 @@ class TestRouterPolicy:
         assert pol.ready_count == 2
 
 
+# ------------------------------------------- cache-aware steering
+
+
+def _advertise(pol, index, digest, t=2.0):
+    """Deliver a fresh heartbeat carrying a hot-prefix advertisement
+    (`prefix_roots`) to one replica, exactly as the engine's beat
+    extra_fn publishes it."""
+    b = beat(t)
+    b["prefix_roots"] = [digest]
+    pol.replicas[index].observe_beat(b, t)
+
+
+class TestCacheAwareSteering:
+    """The tiered-KV fleet half (serve/hostcache.py): replicas
+    advertise hot prefix roots on heartbeats and the dispatch policy
+    steers matching no-session requests there — pure host logic over
+    fabricated beats, zero jit compiles."""
+
+    # short prompt: BELOW prefix_tokens (32), so affinity_key() is None
+    # — only the cache-aware term can see the shared prefix
+    IDS = [7, 8, 9, 7]
+
+    def test_heartbeat_advertises_and_clears_roots(self, tmp_path):
+        from hyperion_tpu.serve.hostcache import prefix_root_digest
+
+        rep = mkreps(tmp_path, 1)[0]
+        d = prefix_root_digest(self.IDS)
+        b = beat(1.0)
+        b["prefix_roots"] = [d]
+        assert rep.observe_beat(b, 1.0) == "ready"
+        assert rep.hb_prefix_roots == (d,)
+        # a later beat WITHOUT the key clears the advertisement — a
+        # restarted (cold) engine must not keep attracting traffic on
+        # its dead predecessor's word
+        rep.observe_beat(beat(2.0), 2.0)
+        assert rep.hb_prefix_roots == ()
+
+    def test_no_session_burst_lands_on_advertiser(self, tmp_path):
+        from hyperion_tpu.serve.hostcache import prefix_root_digest
+
+        pol = _ready_policy(tmp_path)
+        _advertise(pol, 2, prefix_root_digest(self.IDS))
+        rep, meta = pol.choose({"prompt_ids": list(self.IDS)})
+        assert rep.index == 2  # NOT the least-loaded tiebreak (0)
+        assert meta["cache_hit"] and not meta["affinity_hit"]
+        assert not meta["had_key"]  # steered purely by advertisement
+
+    def test_degrades_to_least_loaded_past_slack(self, tmp_path):
+        from hyperion_tpu.serve.hostcache import prefix_root_digest
+
+        pol = _ready_policy(tmp_path, affinity_slack=2)
+        _advertise(pol, 1, prefix_root_digest(self.IDS))
+        pol.replicas[1].hb_active = 10  # advertiser is overloaded
+        rep, meta = pol.choose({"prompt_ids": list(self.IDS)})
+        assert rep.index == 0 and not meta["cache_hit"]
+
+    def test_no_advertiser_degrades_to_least_loaded(self, tmp_path):
+        pol = _ready_policy(tmp_path)
+        rep, meta = pol.choose({"prompt_ids": list(self.IDS)})
+        assert rep.index == 0 and not meta["cache_hit"]
+
+    def test_steer_seeds_affinity_for_the_burst(self, tmp_path):
+        from hyperion_tpu.serve.hostcache import prefix_root_digest
+
+        pol = _ready_policy(tmp_path)
+        _advertise(pol, 1, prefix_root_digest(self.IDS))
+        doc = {"session_id": "burst", "prompt_ids": list(self.IDS)}
+        first, m1 = pol.choose(doc)
+        assert first.index == 1 and m1["cache_hit"]
+        # the advertisement goes stale (next beat omits it) — the rest
+        # of the burst STICKS via the affinity map the steer seeded
+        pol.replicas[1].observe_beat(beat(3.0), 3.0)
+        second, m2 = pol.choose(doc)
+        assert second.index == 1
+        assert m2["affinity_hit"] and not m2["cache_hit"]
+
+    def test_affinity_hit_pre_empts_cache_term(self, tmp_path):
+        from hyperion_tpu.serve.hostcache import prefix_root_digest
+
+        pol = _ready_policy(tmp_path)
+        doc = {"session_id": "s", "prompt_ids": list(self.IDS)}
+        target, _ = pol.choose(doc)
+        # a DIFFERENT replica starts advertising the same root: the
+        # established session must not bounce off its sticky target
+        _advertise(pol, (target.index + 1) % 3,
+                   prefix_root_digest(self.IDS))
+        rep, meta = pol.choose(doc)
+        assert rep.index == target.index
+        assert meta["affinity_hit"] and not meta["cache_hit"]
+
+    def test_cache_aware_off_disables_the_term(self, tmp_path):
+        from hyperion_tpu.serve.hostcache import prefix_root_digest
+
+        pol = _ready_policy(tmp_path, cache_aware=False)
+        _advertise(pol, 2, prefix_root_digest(self.IDS))
+        rep, meta = pol.choose({"prompt_ids": list(self.IDS)})
+        assert rep.index == 0 and not meta["cache_hit"]
+
+    def test_metrics_count_cache_steers(self):
+        from hyperion_tpu.serve.metrics import RouterMetrics
+
+        m = RouterMetrics()
+        m.on_dispatch(0, affinity_hit=False, had_key=False)
+        m.on_dispatch(1, affinity_hit=False, had_key=False,
+                      cache_hit=True)
+        assert m.summary()["cache_steered"] == 1
+
+
+class TestReplicaArgvDrift:
+    """The child command `replica_argv` builds from the ROUTE parser's
+    namespace must parse against the SERVE parser it targets — a flag
+    present on one surface but not the other fails here in tier-1, not
+    at replica spawn time inside a live fleet."""
+
+    def test_child_argv_parses_against_serve_surface(self, tmp_path):
+        from hyperion_tpu.serve.router import replica_argv
+        from hyperion_tpu.serve.server import build_parser as serve_parser
+
+        args = build_parser().parse_args(
+            ["--ckpt", "m.npz", "--replicas", "2",
+             "--base-dir", str(tmp_path), "--host-cache-mb", "8"])
+        rep = mkreps(tmp_path, 1)[0]
+        argv = replica_argv(args, rep)
+        assert argv[:4] == [sys.executable, "-m",
+                            "hyperion_tpu.cli.main", "serve"]
+        a = serve_parser().parse_args(argv[4:])
+        assert a.slots == args.slots
+        assert a.queue_capacity == args.queue_capacity
+        assert a.host_cache_mb == 8
+
+    def test_tier_off_route_spawns_tier_off_replicas(self, tmp_path):
+        from hyperion_tpu.serve.router import replica_argv
+        from hyperion_tpu.serve.server import build_parser as serve_parser
+
+        args = build_parser().parse_args(
+            ["--ckpt", "m.npz", "--base-dir", str(tmp_path)])
+        a = serve_parser().parse_args(
+            replica_argv(args, mkreps(tmp_path, 1)[0])[4:])
+        assert a.host_cache_mb == 0
+
+
 # ------------------------------------------------------------- dedup
 
 

@@ -472,6 +472,122 @@ class TestPagedCache:
         _drain(eng)
 
 
+# ----------------------------------------------- tiered KV (host spill)
+
+
+class TestTieredKV:
+    """The PR-20 tentpole drill, engine half: radix eviction DEMOTES
+    chains to the host tier (serve/hostcache.py), a same-prefix re-hit
+    restores them through the existing COW/scatter path with
+    `tier=host` counted, the restored stream is temp-0 bit-identical
+    to `generate`, `compile_stats()` stays flat across the whole
+    evict→spill→restore cycle, and the store's serialized form feeds a
+    SECOND engine the same hit after a restart. Geometry reuses the
+    paged-churn shapes (slots 3, max_len 48, block_size 8, num_blocks
+    8, optimistic) so the class adds zero jit compiles to tier-1."""
+
+    def _tiered(self, llama, tmp_path, **kw):
+        cfg = dict(slots=3, block_size=8, num_blocks=8,
+                   admission="optimistic", queue_capacity=16,
+                   host_cache_mb=8,
+                   host_cache_dir=str(tmp_path / "hostcache"))
+        cfg.update(kw)
+        return _engine(llama, **cfg)
+
+    def test_evict_spill_restore_bit_identical_zero_compiles(
+            self, tmp_path, llama):
+        model, variables = llama
+        eng = self._tiered(llama, tmp_path)
+        stats0 = eng.warmup()
+        rng = np.random.default_rng(83)
+        shared = rng.integers(1, 250, 16).astype(np.int32)
+
+        # phase 1 — seed: a shared-prefix request leaves its two full
+        # blocks retained by the radix cache
+        seed_req = Request(prompt_ids=np.concatenate(
+            [shared, rng.integers(1, 250, 3).astype(np.int32)]),
+            max_new_tokens=4, id="tk_seed")
+        ok, reason = eng.submit(seed_req)
+        assert ok, reason
+        _drain(eng)
+        assert eng.prefix.evictable() >= 2
+
+        # phase 2 — pressure: growers overflow the 7-usable-block pool,
+        # so LRU eviction fires and the dying chain spills to host RAM
+        # instead of being deleted
+        growers = [Request(prompt_ids=rng.integers(1, 250, 6),
+                           max_new_tokens=12, id=f"tk_gr{i}")
+                   for i in range(3)]
+        for r in growers:
+            ok, reason = eng.submit(r)
+            assert ok, reason
+            eng.step()
+        _drain(eng)
+        s = eng.metrics.summary()
+        assert s["host_spilled_blocks"] >= 2, s
+        assert len(eng.host) >= 2
+
+        # phase 3 — re-hit: same system prompt, different tail; the
+        # device walk misses (the chain was evicted), the host walk
+        # restores it, and the stream is bit-identical anyway
+        rehit = Request(prompt_ids=np.concatenate(
+            [shared, rng.integers(1, 250, 4).astype(np.int32)]),
+            max_new_tokens=4, id="tk_rehit")
+        ok, reason = eng.submit(rehit)
+        assert ok, reason
+        _drain(eng)
+        s = eng.metrics.summary()
+        assert s["tier_hits_host"] >= 1, s
+        assert s["host_restored_blocks"] >= 2, s
+        assert s["tier_hit_rate_host"] > 0
+        assert s["restore_bytes_per_s"] > 0
+        # the restore replaced a 16-token re-prefill
+        assert s["prefill_tokens_saved"] >= 16
+        for r in [seed_req, rehit] + growers:
+            ref = np.asarray(generate(
+                model, variables, jnp.asarray(r.prompt_ids)[None],
+                r.max_new_tokens))[0].tolist()
+            assert r.tokens == ref, f"{r.id}: {r.tokens} != {ref}"
+        # the whole evict→spill→restore cycle is eager host/device
+        # traffic: not one new executable in either jit cache
+        assert eng.compile_stats() == stats0, (
+            "the host tier recompiled the engine")
+
+        # phase 4 — restart survival: the drain serializes the store;
+        # a SECOND engine (fresh radix, fresh pool) loads it and serves
+        # the same prefix from host RAM without ever having decoded it
+        eng.run()   # idle → immediate drain: saves <dir>/hostcache
+        assert (tmp_path / "hostcache" / "index.json").exists()
+        eng2 = self._tiered(llama, tmp_path)
+        assert eng2.warmup() == stats0
+        assert len(eng2.host) >= 2   # loaded at construction
+        surv = Request(prompt_ids=np.concatenate(
+            [shared, rng.integers(1, 250, 5).astype(np.int32)]),
+            max_new_tokens=4, id="tk_surv")
+        ok, reason = eng2.submit(surv)
+        assert ok, reason
+        _drain(eng2)
+        s2 = eng2.metrics.summary()
+        assert s2["tier_hits_host"] >= 1, s2
+        ref = np.asarray(generate(
+            model, variables, jnp.asarray(surv.prompt_ids)[None],
+            4))[0].tolist()
+        assert surv.tokens == ref, f"restart re-hit diverged: {surv.tokens}"
+        assert eng2.compile_stats() == stats0
+
+    def test_tier_off_by_default_and_ledger_reports_host(
+            self, tmp_path, llama):
+        assert EngineConfig(slots=3, max_len=48).host_cache_mb == 0
+        eng = _engine(llama, slots=3, block_size=8, num_blocks=8,
+                      admission="optimistic", queue_capacity=16)
+        assert eng.host is None
+        led = _engine(llama, slots=3, block_size=8, num_blocks=8,
+                      admission="optimistic", queue_capacity=16,
+                      host_cache_mb=8).memory_ledger()
+        assert led["host_cache_budget_mb"] == 8
+        assert led["host_cache_mb"] == 0.0   # nothing spilled yet
+
+
 # ------------------------------------------------------ queue policy
 
 
@@ -954,6 +1070,10 @@ class TestJsonlServer:
         # and the paged-attention round trip really switches the kernel
         assert any(a.paged_attn == "pallas" for a in parsed), (
             "serve_smoke.sh lost the --paged-attn pallas round trip")
+        # and the tiered-KV round trip really turns the host tier on
+        assert any(a.host_cache_mb > 0 for a in parsed), (
+            "serve_smoke.sh lost the --host-cache-mb tiered-KV round "
+            "trip")
 
 
 # -------------------------------------------------------- load + soak
